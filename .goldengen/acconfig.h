@@ -1,0 +1,4 @@
+/* stub acconfig.h for building the reference CRUSH core standalone */
+#ifndef GOLDEN_ACCONFIG_H
+#define GOLDEN_ACCONFIG_H
+#endif

@@ -1,0 +1,645 @@
+/*
+ * Golden-vector generator for the TPU-native CRUSH reimplementation.
+ *
+ * Compiles the *reference* CRUSH C core (read-only mount at
+ * /root/reference/src/crush) and dumps, as JSON data:
+ *   - crush_hash32_{1..5} vectors
+ *   - crush_ln(x) for all x in [0, 0xffff]
+ *   - the __RH_LH_tbl / __LL_tbl fixed-point log tables (numeric data)
+ *   - several maps (in our own JSON map schema) with crush_do_rule
+ *     results over x ranges, rules, numreps and weight vectors
+ *   - a single-thread CPU throughput measurement of crush_do_rule on a
+ *     10k-OSD map (the measured baseline for bench.py vs_baseline)
+ *
+ * Only JSON *data* produced by this program is committed; this scratch
+ * directory is gitignored.
+ */
+#include <stdio.h>
+#include <stdarg.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+/* pull in the reference implementation (static fns become visible) */
+#include "mapper.c"
+#include "builder.h"
+
+/* hash.c / builder.c / crush.c are compiled separately and linked */
+
+static FILE *out;
+
+static FILE *xfopen(const char *p)
+{
+	FILE *f = fopen(p, "w");
+	if (!f) { fprintf(stderr, "cannot open %s\n", p); exit(1); }
+	return f;
+}
+
+static void emit(const char *fmt, ...)
+{
+	va_list ap;
+	va_start(ap, fmt);
+	vfprintf(out, fmt, ap);
+	va_end(ap);
+}
+
+/* ---------- JSON helpers ---------- */
+static void emit_i32_array(const char *name, const int *v, int n)
+{
+	int i;
+	emit("\"%s\": [", name);
+	for (i = 0; i < n; i++)
+		emit("%s%d", i ? "," : "", v[i]);
+	emit("]");
+}
+static void emit_u32_array(const char *name, const __u32 *v, int n)
+{
+	int i;
+	emit("\"%s\": [", name);
+	for (i = 0; i < n; i++)
+		emit("%s%u", i ? "," : "", v[i]);
+	emit("]");
+}
+
+/* ---------- map construction helpers ---------- */
+
+struct testmap {
+	struct crush_map *map;
+	struct crush_choose_arg *choose_args; /* may be NULL */
+};
+
+static int add_bucket(struct crush_map *map, int alg, int type,
+		      int size, int *items, int *weights, int *id_out)
+{
+	struct crush_bucket *b =
+		crush_make_bucket(map, alg, CRUSH_HASH_RJENKINS1, type,
+				  size, items, weights);
+	if (!b) { fprintf(stderr, "make_bucket failed\n"); exit(1); }
+	int r = crush_add_bucket(map, 0, b, id_out);
+	if (r < 0) { fprintf(stderr, "add_bucket failed\n"); exit(1); }
+	return *id_out;
+}
+
+/* build an H-level hierarchy: nroot children per level ... leaves are osds.
+ * returns root bucket id.  types: osd=0, level1=1, ... root=levels  */
+static int build_tree_map(struct crush_map *map, int alg,
+			  int levels, const int *fanout /* len=levels */,
+			  int *osd_count_out, const int *osd_weights /* or NULL */)
+{
+	/* recursively build */
+	int next_osd = 0;
+	int build(int level) { /* gcc nested fn, fine for the generator */
+		if (level == 0)
+			return next_osd++;
+		int n = fanout[level - 1];
+		int items[n], weights[n];
+		for (int i = 0; i < n; i++) {
+			items[i] = build(level - 1);
+			if (level == 1)
+				weights[i] = osd_weights ? osd_weights[items[i]]
+							 : 0x10000;
+			else
+				weights[i] = 0; /* fixed up by make_bucket: it
+						   sums child weights only for
+						   leaf weights we pass; for
+						   bucket children pass their
+						   weight */
+		}
+		if (level > 1) {
+			/* child bucket weights: look them up */
+			for (int i = 0; i < n; i++) {
+				struct crush_bucket *cb =
+					map->buckets[-1 - items[i]];
+				weights[i] = cb->weight;
+			}
+		}
+		int id;
+		add_bucket(map, alg, level, n, items, weights, &id);
+		return id;
+	}
+	int root = build(levels);
+	*osd_count_out = next_osd;
+	return root;
+}
+
+static struct crush_rule *mk_rule(struct crush_map *map, int len)
+{
+	struct crush_rule *r = crush_make_rule(len, 1);
+	if (!r) exit(1);
+	return r;
+}
+
+/* ---------- dumping a map in our JSON schema ---------- */
+static void dump_bucket(struct crush_bucket *b, int first)
+{
+	emit("%s{\"id\": %d, \"alg\": %d, \"hash\": %d, \"type\": %d, "
+	     "\"weight\": %u, \"size\": %u, ",
+	     first ? "" : ",", b->id, b->alg, b->hash, b->type, b->weight,
+	     b->size);
+	emit_i32_array("items", b->items, b->size);
+	switch (b->alg) {
+	case CRUSH_BUCKET_UNIFORM: {
+		struct crush_bucket_uniform *u = (void *)b;
+		emit(", \"item_weight\": %u", u->item_weight);
+		break;
+	}
+	case CRUSH_BUCKET_LIST: {
+		struct crush_bucket_list *l = (void *)b;
+		emit(", ");
+		emit_u32_array("item_weights", l->item_weights, b->size);
+		emit(", ");
+		emit_u32_array("sum_weights", l->sum_weights, b->size);
+		break;
+	}
+	case CRUSH_BUCKET_TREE: {
+		struct crush_bucket_tree *t = (void *)b;
+		emit(", \"num_nodes\": %d, ", t->num_nodes);
+		emit_u32_array("node_weights", t->node_weights, t->num_nodes);
+		break;
+	}
+	case CRUSH_BUCKET_STRAW: {
+		struct crush_bucket_straw *s = (void *)b;
+		emit(", ");
+		emit_u32_array("item_weights", s->item_weights, b->size);
+		emit(", ");
+		emit_u32_array("straws", s->straws, b->size);
+		break;
+	}
+	case CRUSH_BUCKET_STRAW2: {
+		struct crush_bucket_straw2 *s = (void *)b;
+		emit(", ");
+		emit_u32_array("item_weights", s->item_weights, b->size);
+		break;
+	}
+	}
+	emit("}");
+}
+
+static void dump_map(struct crush_map *map, struct crush_choose_arg *cargs)
+{
+	int i, j, first;
+	emit("\"map\": {");
+	emit("\"max_devices\": %d, \"max_buckets\": %d, \"max_rules\": %u, ",
+	     map->max_devices, map->max_buckets, map->max_rules);
+	emit("\"tunables\": {\"choose_local_tries\": %u, "
+	     "\"choose_local_fallback_tries\": %u, \"choose_total_tries\": %u, "
+	     "\"chooseleaf_descend_once\": %u, \"chooseleaf_vary_r\": %u, "
+	     "\"chooseleaf_stable\": %u}, ",
+	     map->choose_local_tries, map->choose_local_fallback_tries,
+	     map->choose_total_tries, map->chooseleaf_descend_once,
+	     map->chooseleaf_vary_r, map->chooseleaf_stable);
+	emit("\"buckets\": [");
+	first = 1;
+	for (i = 0; i < map->max_buckets; i++) {
+		if (!map->buckets[i])
+			continue;
+		dump_bucket(map->buckets[i], first);
+		first = 0;
+	}
+	emit("], \"rules\": [");
+	first = 1;
+	for (i = 0; i < (int)map->max_rules; i++) {
+		struct crush_rule *r = map->rules[i];
+		if (!r)
+			continue;
+		emit("%s{\"ruleno\": %d, \"steps\": [", first ? "" : ",", i);
+		for (j = 0; j < (int)r->len; j++)
+			emit("%s[%u,%d,%d]", j ? "," : "", r->steps[j].op,
+			     r->steps[j].arg1, r->steps[j].arg2);
+		emit("]}");
+		first = 0;
+	}
+	emit("]");
+	if (cargs) {
+		emit(", \"choose_args\": [");
+		first = 1;
+		for (i = 0; i < map->max_buckets; i++) {
+			struct crush_choose_arg *a = &cargs[i];
+			if (!map->buckets[i])
+				continue;
+			emit("%s{\"bucket_index\": %d", first ? "" : ",", i);
+			if (a->ids) {
+				emit(", ");
+				emit_i32_array("ids", a->ids, a->ids_size);
+			}
+			if (a->weight_set) {
+				emit(", \"weight_set\": [");
+				for (j = 0; j < (int)a->weight_set_positions; j++) {
+					emit("%s[", j ? "," : "");
+					for (unsigned k = 0; k < a->weight_set[j].size; k++)
+						emit("%s%u", k ? "," : "",
+						     a->weight_set[j].weights[k]);
+					emit("]");
+				}
+				emit("]");
+			}
+			emit("}");
+			first = 0;
+		}
+		emit("]");
+	}
+	emit("}");
+}
+
+/* ---------- run do_rule over a range and dump results ---------- */
+static void run_cases(struct crush_map *map, struct crush_choose_arg *cargs,
+		      const __u32 *weight, int weight_max,
+		      int ruleno, int numrep, int x0, int x1)
+{
+	int *result = malloc(sizeof(int) * (numrep + 8) * 4);
+	char *cwin = malloc(map->working_size + sizeof(int) * 3 * numrep);
+	emit("{\"ruleno\": %d, \"numrep\": %d, \"x0\": %d, \"x1\": %d, ",
+	     ruleno, numrep, x0, x1);
+	emit_u32_array("weight", weight, weight_max);
+	emit(", \"results\": [");
+	for (int x = x0; x < x1; x++) {
+		crush_init_workspace(map, cwin);
+		int n = crush_do_rule(map, ruleno, x, result, numrep,
+				      weight, weight_max, cwin, cargs);
+		emit("%s[", x == x0 ? "" : ",");
+		for (int i = 0; i < n; i++)
+			emit("%s%d", i ? "," : "", result[i]);
+		emit("]");
+	}
+	emit("]}");
+	free(result); free(cwin);
+}
+
+/* weight vector builders */
+static void w_fill(__u32 *w, int n, __u32 v) { for (int i = 0; i < n; i++) w[i] = v; }
+
+/* ---------- main ---------- */
+int main(int argc, char **argv)
+{
+	const char *outdir = argc > 1 ? argv[1] : ".";
+	char path[512];
+
+	/* ===== 1. hash goldens ===== */
+	snprintf(path, sizeof(path), "%s/hash.json", outdir);
+	out = xfopen(path);
+	emit("{\"seed\": %u, \"cases\": [", 1315423911u);
+	__u32 inputs[] = {0, 1, 2, 3, 12345, 0x7fffffff, 0x80000000u,
+			  0xffffffffu, 0xdeadbeefu, 1315423911u, 65535, 65536};
+	int ni = sizeof(inputs) / sizeof(inputs[0]);
+	int first = 1;
+	for (int i = 0; i < ni; i++)
+		for (int j = 0; j < ni; j++) {
+			__u32 a = inputs[i], b = inputs[j];
+			emit("%s[%u,%u,%u,%u,%u,%u,%u]", first ? "" : ",",
+			     a, b,
+			     crush_hash32(CRUSH_HASH_RJENKINS1, a),
+			     crush_hash32_2(CRUSH_HASH_RJENKINS1, a, b),
+			     crush_hash32_3(CRUSH_HASH_RJENKINS1, a, b, a ^ b),
+			     crush_hash32_4(CRUSH_HASH_RJENKINS1, a, b, a + b, a - b),
+			     crush_hash32_5(CRUSH_HASH_RJENKINS1, a, b, a + b, a - b, a * 3 + b));
+			first = 0;
+		}
+	emit("]}");
+	fclose(out);
+
+	/* ===== 2. crush_ln sweep + tables ===== */
+	snprintf(path, sizeof(path), "%s/crush_ln.json", outdir);
+	out = xfopen(path);
+	emit("{\"ln\": [");
+	for (int x = 0; x <= 0xffff; x++)
+		emit("%s%llu", x ? "," : "", (unsigned long long)crush_ln(x));
+	emit("], \"RH_LH_tbl\": [");
+	for (int i = 0; i < 128 * 2 + 2; i++)
+		emit("%s%lld", i ? "," : "", (long long)__RH_LH_tbl[i]);
+	emit("], \"LL_tbl\": [");
+	for (int i = 0; i < 256; i++)
+		emit("%s%lld", i ? "," : "", (long long)__LL_tbl[i]);
+	emit("]}");
+	fclose(out);
+
+	/* ===== 3. maps + do_rule goldens ===== */
+
+	/* --- M1: flat 12-osd straw2, mixed weights --- */
+	{
+		struct crush_map *m = crush_create();
+		int items[12], weights[12];
+		for (int i = 0; i < 12; i++) {
+			items[i] = i;
+			weights[i] = 0x10000;
+		}
+		weights[3] = 0x18000;  /* 1.5 */
+		weights[7] = 0x8000;   /* 0.5 */
+		weights[11] = 0x20000; /* 2.0 */
+		int root;
+		add_bucket(m, CRUSH_BUCKET_STRAW2, 1, 12, items, weights, &root);
+		struct crush_rule *r = mk_rule(m, 3);
+		crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, root, 0);
+		crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSE_FIRSTN, 0, 0);
+		crush_rule_set_step(r, 2, CRUSH_RULE_EMIT, 0, 0);
+		crush_add_rule(m, r, 0);
+		struct crush_rule *r2 = mk_rule(m, 3);
+		crush_rule_set_step(r2, 0, CRUSH_RULE_TAKE, root, 0);
+		crush_rule_set_step(r2, 1, CRUSH_RULE_CHOOSE_INDEP, 0, 0);
+		crush_rule_set_step(r2, 2, CRUSH_RULE_EMIT, 0, 0);
+		crush_add_rule(m, r2, 1);
+		crush_finalize(m);
+
+		snprintf(path, sizeof(path), "%s/map_flat12.json", outdir);
+		out = xfopen(path);
+		emit("{");
+		dump_map(m, NULL);
+		emit(", \"cases\": [");
+		__u32 w[12];
+		w_fill(w, 12, 0x10000);
+		run_cases(m, NULL, w, 12, 0, 3, 0, 1024); emit(",");
+		run_cases(m, NULL, w, 12, 1, 4, 0, 1024); emit(",");
+		/* osd.2 out, osd.5 half-out */
+		w[2] = 0; w[5] = 0x8000;
+		run_cases(m, NULL, w, 12, 0, 3, 0, 1024); emit(",");
+		run_cases(m, NULL, w, 12, 1, 6, 0, 1024);
+		emit("]}");
+		fclose(out);
+		crush_destroy(m);
+	}
+
+	/* --- M2: 3-level hierarchy (3 racks x 3 hosts x 4 osds) straw2 --- */
+	{
+		struct crush_map *m = crush_create();
+		int fanout[3] = {4, 3, 3}; /* level1(host)=4 osds, level2(rack)=3 hosts, level3(root)=3 racks */
+		int nosd;
+		int osd_w[36];
+		for (int i = 0; i < 36; i++)
+			osd_w[i] = 0x10000 + (i % 5) * 0x4000; /* varied */
+		int root = build_tree_map(m, CRUSH_BUCKET_STRAW2, 3, fanout,
+					  &nosd, osd_w);
+		/* rule 0: replicated chooseleaf firstn over racks */
+		struct crush_rule *r = mk_rule(m, 3);
+		crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, root, 0);
+		crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 2);
+		crush_rule_set_step(r, 2, CRUSH_RULE_EMIT, 0, 0);
+		crush_add_rule(m, r, 0);
+		/* rule 1: EC chooseleaf indep over hosts */
+		struct crush_rule *r1 = mk_rule(m, 3);
+		crush_rule_set_step(r1, 0, CRUSH_RULE_TAKE, root, 0);
+		crush_rule_set_step(r1, 1, CRUSH_RULE_CHOOSELEAF_INDEP, 0, 1);
+		crush_rule_set_step(r1, 2, CRUSH_RULE_EMIT, 0, 0);
+		crush_add_rule(m, r1, 1);
+		/* rule 2: two-step choose: 2 racks then 2 hosts then osds */
+		struct crush_rule *r2 = mk_rule(m, 5);
+		crush_rule_set_step(r2, 0, CRUSH_RULE_TAKE, root, 0);
+		crush_rule_set_step(r2, 1, CRUSH_RULE_CHOOSE_FIRSTN, 2, 2);
+		crush_rule_set_step(r2, 2, CRUSH_RULE_CHOOSE_FIRSTN, 2, 1);
+		crush_rule_set_step(r2, 3, CRUSH_RULE_CHOOSELEAF_FIRSTN, 1, 0);
+		crush_rule_set_step(r2, 4, CRUSH_RULE_EMIT, 0, 0);
+		crush_add_rule(m, r2, 2);
+		/* rule 3: indep with set_chooseleaf_tries + set_choose_tries */
+		struct crush_rule *r3 = mk_rule(m, 5);
+		crush_rule_set_step(r3, 0, CRUSH_RULE_SET_CHOOSE_TRIES, 100, 0);
+		crush_rule_set_step(r3, 1, CRUSH_RULE_SET_CHOOSELEAF_TRIES, 5, 0);
+		crush_rule_set_step(r3, 2, CRUSH_RULE_TAKE, root, 0);
+		crush_rule_set_step(r3, 3, CRUSH_RULE_CHOOSELEAF_INDEP, 0, 1);
+		crush_rule_set_step(r3, 4, CRUSH_RULE_EMIT, 0, 0);
+		crush_add_rule(m, r3, 3);
+		/* rule 4: multi-take/emit */
+		struct crush_rule *r4 = mk_rule(m, 6);
+		int rack0 = m->buckets[-1 - root]->items[0];
+		int rack1 = m->buckets[-1 - root]->items[1];
+		crush_rule_set_step(r4, 0, CRUSH_RULE_TAKE, rack0, 0);
+		crush_rule_set_step(r4, 1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 2, 1);
+		crush_rule_set_step(r4, 2, CRUSH_RULE_EMIT, 0, 0);
+		crush_rule_set_step(r4, 3, CRUSH_RULE_TAKE, rack1, 0);
+		crush_rule_set_step(r4, 4, CRUSH_RULE_CHOOSELEAF_FIRSTN, 1, 1);
+		crush_rule_set_step(r4, 5, CRUSH_RULE_EMIT, 0, 0);
+		crush_add_rule(m, r4, 4);
+		crush_finalize(m);
+
+		snprintf(path, sizeof(path), "%s/map_tree3.json", outdir);
+		out = xfopen(path);
+		emit("{");
+		dump_map(m, NULL);
+		emit(", \"cases\": [");
+		__u32 w[36];
+		w_fill(w, 36, 0x10000);
+		run_cases(m, NULL, w, 36, 0, 3, 0, 512); emit(",");
+		run_cases(m, NULL, w, 36, 1, 6, 0, 512); emit(",");
+		run_cases(m, NULL, w, 36, 2, 4, 0, 512); emit(",");
+		run_cases(m, NULL, w, 36, 3, 6, 0, 512); emit(",");
+		run_cases(m, NULL, w, 36, 4, 3, 0, 512); emit(",");
+		/* failures: one host down (osds 4..7), a few singles */
+		for (int i = 4; i < 8; i++) w[i] = 0;
+		w[17] = 0; w[30] = 0x4000;
+		run_cases(m, NULL, w, 36, 0, 3, 0, 512); emit(",");
+		run_cases(m, NULL, w, 36, 1, 6, 0, 512); emit(",");
+		run_cases(m, NULL, w, 36, 3, 6, 0, 512);
+		emit("]}");
+		fclose(out);
+
+		/* --- M7: same topology + choose_args --- */
+		struct crush_choose_arg *ca = crush_make_choose_args(m, 2);
+		/* perturb the weight sets & ids to be different from defaults */
+		for (int b = 0; b < m->max_buckets; b++) {
+			if (!m->buckets[b]) continue;
+			struct crush_choose_arg *a = &ca[b];
+			for (unsigned p = 0; p < a->weight_set_positions; p++)
+				for (unsigned k = 0; k < a->weight_set[p].size; k++) {
+					__u32 wv = a->weight_set[p].weights[k];
+					a->weight_set[p].weights[k] =
+						wv - (wv >> (2 + p + (k & 1)));
+				}
+			/* remap ids for leaf buckets only (type 1 = host):
+			 * mimic the balancer's pseudo-id trick */
+			if (m->buckets[b]->type == 1)
+				for (unsigned k = 0; k < a->ids_size; k++)
+					a->ids[k] = a->ids[k] + 1000;
+		}
+		snprintf(path, sizeof(path), "%s/map_tree3_chooseargs.json", outdir);
+		out = xfopen(path);
+		emit("{");
+		dump_map(m, ca);
+		emit(", \"cases\": [");
+		w_fill(w, 36, 0x10000);
+		run_cases(m, ca, w, 36, 0, 3, 0, 512); emit(",");
+		run_cases(m, ca, w, 36, 1, 6, 0, 512); emit(",");
+		run_cases(m, ca, w, 36, 2, 4, 0, 512);
+		emit("]}");
+		fclose(out);
+		crush_destroy_choose_args(ca);
+
+		/* --- M6: legacy tunables on same topology --- */
+		set_legacy_crush_map(m);
+		m->allowed_bucket_algs |= (1 << CRUSH_BUCKET_STRAW2);
+		crush_finalize(m);
+		snprintf(path, sizeof(path), "%s/map_tree3_legacy.json", outdir);
+		out = xfopen(path);
+		emit("{");
+		dump_map(m, NULL);
+		emit(", \"cases\": [");
+		w_fill(w, 36, 0x10000);
+		run_cases(m, NULL, w, 36, 0, 3, 0, 512); emit(",");
+		run_cases(m, NULL, w, 36, 1, 6, 0, 512); emit(",");
+		for (int i = 4; i < 8; i++) w[i] = 0;
+		run_cases(m, NULL, w, 36, 0, 3, 0, 512);
+		emit("]}");
+		fclose(out);
+		crush_destroy(m);
+	}
+
+	/* --- M3/M4/M5: uniform / list / straw hierarchies --- */
+	int algs[3] = {CRUSH_BUCKET_UNIFORM, CRUSH_BUCKET_LIST,
+		       CRUSH_BUCKET_STRAW};
+	const char *algname[3] = {"uniform", "list", "straw"};
+	for (int ai = 0; ai < 3; ai++) {
+		struct crush_map *m = crush_create();
+		int fanout[2] = {4, 4}; /* 4 hosts x 4 osds */
+		int nosd;
+		/* uniform requires equal weights within a bucket */
+		int osd_w[16];
+		for (int i = 0; i < 16; i++)
+			osd_w[i] = (algs[ai] == CRUSH_BUCKET_UNIFORM)
+					   ? 0x10000
+					   : 0x10000 + (i % 4) * 0x6000;
+		int root = build_tree_map(m, algs[ai], 2, fanout, &nosd, osd_w);
+		struct crush_rule *r = mk_rule(m, 3);
+		crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, root, 0);
+		crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 1);
+		crush_rule_set_step(r, 2, CRUSH_RULE_EMIT, 0, 0);
+		crush_add_rule(m, r, 0);
+		struct crush_rule *r1 = mk_rule(m, 3);
+		crush_rule_set_step(r1, 0, CRUSH_RULE_TAKE, root, 0);
+		crush_rule_set_step(r1, 1, CRUSH_RULE_CHOOSELEAF_INDEP, 0, 1);
+		crush_rule_set_step(r1, 2, CRUSH_RULE_EMIT, 0, 0);
+		crush_add_rule(m, r1, 1);
+		crush_finalize(m);
+		snprintf(path, sizeof(path), "%s/map_%s.json", outdir,
+			 algname[ai]);
+		out = xfopen(path);
+		emit("{");
+		dump_map(m, NULL);
+		emit(", \"cases\": [");
+		__u32 w[16];
+		w_fill(w, 16, 0x10000);
+		run_cases(m, NULL, w, 16, 0, 3, 0, 512); emit(",");
+		run_cases(m, NULL, w, 16, 1, 4, 0, 512); emit(",");
+		w[1] = 0; w[9] = 0;
+		run_cases(m, NULL, w, 16, 0, 3, 0, 512); emit(",");
+		run_cases(m, NULL, w, 16, 1, 4, 0, 512);
+		emit("]}");
+		fclose(out);
+		crush_destroy(m);
+	}
+
+	/* --- M8: weird cases: empty-ish buckets, N_MINUS, big numrep --- */
+	{
+		struct crush_map *m = crush_create();
+		int items[6], weights[6];
+		for (int i = 0; i < 6; i++) { items[i] = i; weights[i] = 0x10000; }
+		weights[4] = 0; weights[5] = 0; /* zero-weight items in bucket */
+		int hostA, hostB, root;
+		add_bucket(m, CRUSH_BUCKET_STRAW2, 1, 6, items, weights, &hostA);
+		int itemsB[2] = {6, 7};
+		int weightsB[2] = {0x10000, 0x30000};
+		add_bucket(m, CRUSH_BUCKET_STRAW2, 1, 2, itemsB, weightsB, &hostB);
+		int ritems[2] = {hostA, hostB};
+		int rweights[2];
+		rweights[0] = m->buckets[-1 - hostA]->weight;
+		rweights[1] = m->buckets[-1 - hostB]->weight;
+		add_bucket(m, CRUSH_BUCKET_STRAW2, 2, 2, ritems, rweights, &root);
+		/* rule 0: numrep larger than available leaves */
+		struct crush_rule *r = mk_rule(m, 3);
+		crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, root, 0);
+		crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 1);
+		crush_rule_set_step(r, 2, CRUSH_RULE_EMIT, 0, 0);
+		crush_add_rule(m, r, 0);
+		/* rule 1: N_MINUS(-1) */
+		struct crush_rule *r1 = mk_rule(m, 3);
+		crush_rule_set_step(r1, 0, CRUSH_RULE_TAKE, root, 0);
+		crush_rule_set_step(r1, 1, CRUSH_RULE_CHOOSE_INDEP, -1, 1);
+		crush_rule_set_step(r1, 2, CRUSH_RULE_EMIT, 0, 0);
+		crush_add_rule(m, r1, 1);
+		/* rule 2: take a device directly (degenerate) */
+		struct crush_rule *r2 = mk_rule(m, 2);
+		crush_rule_set_step(r2, 0, CRUSH_RULE_TAKE, 0, 0);
+		crush_rule_set_step(r2, 1, CRUSH_RULE_EMIT, 0, 0);
+		crush_add_rule(m, r2, 2);
+		crush_finalize(m);
+		snprintf(path, sizeof(path), "%s/map_weird.json", outdir);
+		out = xfopen(path);
+		emit("{");
+		dump_map(m, NULL);
+		emit(", \"cases\": [");
+		__u32 w[8];
+		w_fill(w, 8, 0x10000);
+		run_cases(m, NULL, w, 8, 0, 8, 0, 512); emit(",");
+		run_cases(m, NULL, w, 8, 1, 4, 0, 512); emit(",");
+		run_cases(m, NULL, w, 8, 2, 3, 0, 128); emit(",");
+		w[0] = 0; w[6] = 0x2000;
+		run_cases(m, NULL, w, 8, 0, 8, 0, 512);
+		emit("]}");
+		fclose(out);
+		crush_destroy(m);
+	}
+
+	/* --- M9: 10k-OSD map: 20 racks x 25 hosts x 20 osds --- */
+	{
+		struct crush_map *m = crush_create();
+		int fanout[3] = {20, 25, 20};
+		int nosd;
+		int root = build_tree_map(m, CRUSH_BUCKET_STRAW2, 3, fanout,
+					  &nosd, NULL);
+		struct crush_rule *r = mk_rule(m, 3);
+		crush_rule_set_step(r, 0, CRUSH_RULE_TAKE, root, 0);
+		crush_rule_set_step(r, 1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 1);
+		crush_rule_set_step(r, 2, CRUSH_RULE_EMIT, 0, 0);
+		crush_add_rule(m, r, 0);
+		struct crush_rule *r1 = mk_rule(m, 3);
+		crush_rule_set_step(r1, 0, CRUSH_RULE_TAKE, root, 0);
+		crush_rule_set_step(r1, 1, CRUSH_RULE_CHOOSELEAF_INDEP, 0, 1);
+		crush_rule_set_step(r1, 2, CRUSH_RULE_EMIT, 0, 0);
+		crush_add_rule(m, r1, 1);
+		crush_finalize(m);
+		fprintf(stderr, "10k map: %d osds, working_size %zu\n", nosd,
+			m->working_size);
+
+		__u32 *w = malloc(sizeof(__u32) * nosd);
+		w_fill(w, nosd, 0x10000);
+		/* golden sample */
+		snprintf(path, sizeof(path), "%s/map_big10k.json", outdir);
+		out = xfopen(path);
+		emit("{");
+		dump_map(m, NULL);
+		emit(", \"cases\": [");
+		run_cases(m, NULL, w, nosd, 0, 3, 0, 256); emit(",");
+		run_cases(m, NULL, w, nosd, 1, 11, 0, 256);
+		emit("]}");
+		fclose(out);
+
+		/* CPU throughput measurement (single thread), numrep=3,
+		 * mirrors the CrushTester x-loop (CrushTester.cc:573) */
+		{
+			int result[3];
+			char *cwin = malloc(m->working_size + sizeof(int) * 3 * 3);
+			struct timespec t0, t1;
+			int iters = 200000;
+			long long acc = 0;
+			clock_gettime(CLOCK_MONOTONIC, &t0);
+			for (int x = 0; x < iters; x++) {
+				crush_init_workspace(m, cwin);
+				int n = crush_do_rule(m, 0, x, result, 3, w,
+						      nosd, cwin, NULL);
+				acc += n ? result[0] : 0;
+			}
+			clock_gettime(CLOCK_MONOTONIC, &t1);
+			double dt = (t1.tv_sec - t0.tv_sec) +
+				    (t1.tv_nsec - t0.tv_nsec) * 1e-9;
+			snprintf(path, sizeof(path), "%s/cpu_baseline.json",
+				 outdir);
+			out = xfopen(path);
+			emit("{\"config\": \"10k-osd 3-level straw2, chooseleaf firstn numrep=3\", "
+			     "\"iters\": %d, \"seconds\": %.6f, "
+			     "\"mappings_per_sec\": %.1f, \"checksum\": %lld}",
+			     iters, dt, iters / dt, acc);
+			fclose(out);
+			free(cwin);
+		}
+		free(w);
+		crush_destroy(m);
+	}
+
+	fprintf(stderr, "golden generation done\n");
+	return 0;
+}
