@@ -1,0 +1,238 @@
+"""The rjenkins1 32-bit mix hash that drives every CRUSH draw.
+
+Bit-exact reimplementation of the reference semantics (src/crush/hash.c:12-90,
+seed 1315423911 at hash.c:24).  Written array-generic: every operation is
+plain ``+ - ^ << >>`` on unsigned 32-bit values, so the same code runs on
+
+- numpy uint32 arrays / scalars (the scalar reference mapper, host tools), and
+- jax.numpy uint32 tracers (the vmapped TPU mapper),
+
+both of which wrap modulo 2^32 like the C ``__u32`` ops do.
+
+Verified bit-exact against tests/golden/hash.json (generated from the
+reference C).
+"""
+
+import functools
+
+import numpy as np
+
+CRUSH_HASH_SEED = 0x4E67C6A7  # 1315423911
+
+_X = 231232
+_Y = 1232
+
+
+def _wrapping(f):
+    """Silence numpy's overflow warnings: u32 wraparound is the contract."""
+
+    @functools.wraps(f)
+    def g(*args):
+        with np.errstate(over="ignore"):
+            return f(*args)
+
+    return g
+
+
+def _u32(v):
+    """Promote a python int to numpy uint32; pass arrays/tracers through."""
+    if isinstance(v, (int, np.integer)):
+        return np.uint32(v & 0xFFFFFFFF)
+    return v
+
+
+_M = 0xFFFFFFFF
+
+
+def _mix_int(a, b, c):
+    """Pure-python-int mix round (fast path for the scalar reference)."""
+    a = (a - b - c) & _M
+    a ^= c >> 13
+    b = (b - c - a) & _M
+    b ^= (a << 8) & _M
+    c = (c - a - b) & _M
+    c ^= b >> 13
+    a = (a - b - c) & _M
+    a ^= c >> 12
+    b = (b - c - a) & _M
+    b ^= (a << 16) & _M
+    c = (c - a - b) & _M
+    c ^= b >> 5
+    a = (a - b - c) & _M
+    a ^= c >> 3
+    b = (b - c - a) & _M
+    b ^= (a << 10) & _M
+    c = (c - a - b) & _M
+    c ^= b >> 15
+    return a, b, c
+
+
+def hash32_int(a):
+    a &= _M
+    h = (CRUSH_HASH_SEED ^ a) & _M
+    b, x, y = a, _X, _Y
+    b, x, h = _mix_int(b, x, h)
+    y, a, h = _mix_int(y, a, h)
+    return h
+
+
+def hash32_2_int(a, b):
+    a &= _M
+    b &= _M
+    h = (CRUSH_HASH_SEED ^ a ^ b) & _M
+    x, y = _X, _Y
+    a, b, h = _mix_int(a, b, h)
+    x, a, h = _mix_int(x, a, h)
+    b, y, h = _mix_int(b, y, h)
+    return h
+
+
+def hash32_3_int(a, b, c):
+    a &= _M
+    b &= _M
+    c &= _M
+    h = (CRUSH_HASH_SEED ^ a ^ b ^ c) & _M
+    x, y = _X, _Y
+    a, b, h = _mix_int(a, b, h)
+    c, x, h = _mix_int(c, x, h)
+    y, a, h = _mix_int(y, a, h)
+    b, x, h = _mix_int(b, x, h)
+    y, c, h = _mix_int(y, c, h)
+    return h
+
+
+def hash32_4_int(a, b, c, d):
+    a &= _M
+    b &= _M
+    c &= _M
+    d &= _M
+    h = (CRUSH_HASH_SEED ^ a ^ b ^ c ^ d) & _M
+    x, y = _X, _Y
+    a, b, h = _mix_int(a, b, h)
+    c, d, h = _mix_int(c, d, h)
+    a, x, h = _mix_int(a, x, h)
+    y, b, h = _mix_int(y, b, h)
+    c, x, h = _mix_int(c, x, h)
+    y, d, h = _mix_int(y, d, h)
+    return h
+
+
+def hash32_5_int(a, b, c, d, e):
+    a &= _M
+    b &= _M
+    c &= _M
+    d &= _M
+    e &= _M
+    h = (CRUSH_HASH_SEED ^ a ^ b ^ c ^ d ^ e) & _M
+    x, y = _X, _Y
+    a, b, h = _mix_int(a, b, h)
+    c, d, h = _mix_int(c, d, h)
+    e, x, h = _mix_int(e, x, h)
+    y, a, h = _mix_int(y, a, h)
+    b, x, h = _mix_int(b, x, h)
+    y, c, h = _mix_int(y, c, h)
+    d, x, h = _mix_int(d, x, h)
+    y, e, h = _mix_int(y, e, h)
+    return h
+
+
+def _mix(a, b, c):
+    """One rjenkins mix round over three u32 lanes (hash.c:12-22)."""
+    a = a - b
+    a = a - c
+    a = a ^ (c >> 13)
+    b = b - c
+    b = b - a
+    b = b ^ (a << 8)
+    c = c - a
+    c = c - b
+    c = c ^ (b >> 13)
+    a = a - b
+    a = a - c
+    a = a ^ (c >> 12)
+    b = b - c
+    b = b - a
+    b = b ^ (a << 16)
+    c = c - a
+    c = c - b
+    c = c ^ (b >> 5)
+    a = a - b
+    a = a - c
+    a = a ^ (c >> 3)
+    b = b - c
+    b = b - a
+    b = b ^ (a << 10)
+    c = c - a
+    c = c - b
+    c = c ^ (b >> 15)
+    return a, b, c
+
+
+@_wrapping
+def crush_hash32(a):
+    a = _u32(a)
+    h = _u32(CRUSH_HASH_SEED) ^ a
+    b = a
+    x = _u32(_X)
+    y = _u32(_Y)
+    b, x, h = _mix(b, x, h)
+    y, a, h = _mix(y, a, h)
+    return h
+
+
+@_wrapping
+def crush_hash32_2(a, b):
+    a, b = _u32(a), _u32(b)
+    h = _u32(CRUSH_HASH_SEED) ^ a ^ b
+    x = _u32(_X)
+    y = _u32(_Y)
+    a, b, h = _mix(a, b, h)
+    x, a, h = _mix(x, a, h)
+    b, y, h = _mix(b, y, h)
+    return h
+
+
+@_wrapping
+def crush_hash32_3(a, b, c):
+    a, b, c = _u32(a), _u32(b), _u32(c)
+    h = _u32(CRUSH_HASH_SEED) ^ a ^ b ^ c
+    x = _u32(_X)
+    y = _u32(_Y)
+    a, b, h = _mix(a, b, h)
+    c, x, h = _mix(c, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    return h
+
+
+@_wrapping
+def crush_hash32_4(a, b, c, d):
+    a, b, c, d = _u32(a), _u32(b), _u32(c), _u32(d)
+    h = _u32(CRUSH_HASH_SEED) ^ a ^ b ^ c ^ d
+    x = _u32(_X)
+    y = _u32(_Y)
+    a, b, h = _mix(a, b, h)
+    c, d, h = _mix(c, d, h)
+    a, x, h = _mix(a, x, h)
+    y, b, h = _mix(y, b, h)
+    c, x, h = _mix(c, x, h)
+    y, d, h = _mix(y, d, h)
+    return h
+
+
+@_wrapping
+def crush_hash32_5(a, b, c, d, e):
+    a, b, c, d, e = _u32(a), _u32(b), _u32(c), _u32(d), _u32(e)
+    h = _u32(CRUSH_HASH_SEED) ^ a ^ b ^ c ^ d ^ e
+    x = _u32(_X)
+    y = _u32(_Y)
+    a, b, h = _mix(a, b, h)
+    c, d, h = _mix(c, d, h)
+    e, x, h = _mix(e, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    d, x, h = _mix(d, x, h)
+    y, e, h = _mix(y, e, h)
+    return h

@@ -1,0 +1,79 @@
+"""Fixed-point 2^44*log2(x+1) — the heart of the straw2 draw.
+
+Bit-exact reimplementation of the reference semantics
+(crush_ln, src/crush/mapper.c:226-268): normalize the 17-bit input so its
+top bit sits at position 15/16, split into a coarse 7-bit index into the
+reciprocal/log tables and a fine 8-bit correction index, and assemble the
+result as ``(iexpon << 44) + ((LH + LL) >> 4)``.
+
+Array-generic: pass ``xp=numpy`` (default, host tools and the scalar
+reference mapper) or ``xp=jax.numpy`` (inside jit; also pass device-resident
+``tables``).  Verified against the full 16-bit-domain sweep in
+tests/golden/crush_ln.json.
+"""
+
+import numpy as np
+
+from ._ln_tables import LL_TBL, RH_LH_TBL
+
+RH_LH_NP = np.array(RH_LH_TBL, dtype=np.uint64)
+LL_NP = np.array(LL_TBL, dtype=np.uint64)
+
+
+def crush_ln(xin, xp=np, tables=None):
+    """Vectorized crush_ln.  ``xin``: uint32-like in [0, 0xffff].
+
+    Returns uint64 values in (0, 2^48]: 2^44 * log2(xin+1) in fixed point.
+    """
+    if tables is None:
+        rh_lh, ll_tbl = RH_LH_NP, LL_NP
+    else:
+        rh_lh, ll_tbl = tables
+
+    if xp.asarray(0, dtype=xp.uint64).dtype.itemsize != 8:
+        raise RuntimeError(
+            "crush_ln requires real 64-bit integers; enable jax x64 "
+            "(jax.enable_x64(True) or jax_enable_x64=True) before tracing")
+
+    x = xp.asarray(xin, dtype=xp.uint32) + xp.uint32(1)
+
+    # locate the msb of the (at most 17-bit) value, branchlessly, then
+    # normalize so the top bit sits at position 15 (mapper.c:234-243 uses
+    # __builtin_clz; this is the same computation as a 5-step binary search)
+    v = x & xp.uint32(0x1FFFF)
+    p = xp.zeros_like(v)
+    for sh in (16, 8, 4, 2, 1):
+        m = v >> xp.uint32(sh)
+        take = m > 0
+        p = xp.where(take, p + xp.uint32(sh), p)
+        v = xp.where(take, m, v)
+    x = x << xp.where(p < 15, xp.uint32(15) - p, xp.uint32(0))
+    iexpon = xp.where(p < 15, p, xp.uint32(15)).astype(xp.uint64)
+
+    index1 = ((x >> xp.uint32(8)) << xp.uint32(1)).astype(xp.int32)
+    rh = rh_lh[index1 - 256]        # ~ 2^56 / index1
+    lh = rh_lh[index1 + 1 - 256]    # ~ 2^48 * log2(index1/256)
+
+    # RH*x ~ 2^48 * (2^15 + xf); the byte above bit 48 is the fine index
+    xl64 = x.astype(xp.uint64) * rh
+    index2 = ((xl64 >> xp.uint64(48)) & xp.uint64(0xFF)).astype(xp.int32)
+
+    lh = (lh + ll_tbl[index2]) >> xp.uint64(48 - 12 - 32)
+    return (iexpon << xp.uint64(12 + 32)) + lh
+
+
+def straw2_draw(u16, weight, xp=np, tables=None):
+    """The signed straw2 draw: ``div64_s64(crush_ln(u16) - 2^48, weight)``.
+
+    ``u16``: the masked hash draw (hash & 0xffff); ``weight``: 16.16
+    fixed-point item weight (uint32-like).  Zero weights map to S64_MIN
+    (mapper.c:349-353).  Division is C truncation-toward-zero; since the
+    numerator is <= 0 and the divisor > 0, ``-((-ln) // w)`` is exact.
+    """
+    ln = crush_ln(u16, xp=xp, tables=tables)
+    # neg = 2^48 - ln  (>= 0); draw = -(neg // w)
+    neg = (xp.uint64(1 << 48) - ln).astype(xp.int64)
+    w = xp.asarray(weight, dtype=xp.uint32).astype(xp.int64)
+    wsafe = xp.where(w == 0, xp.int64(1), w)
+    draw = -(neg // wsafe)
+    return xp.where(w == 0, xp.int64(-(2**63)), draw)

@@ -1,0 +1,21 @@
+"""Test harness config.
+
+Tests run hermetically on CPU with 8 virtual XLA devices so every multi-chip
+sharding path (pjit/shard_map over a Mesh) is exercised without TPU hardware;
+the driver separately compile-checks the real-chip path via __graft_entry__.
+Must run before anything imports jax.
+"""
+
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+GOLDEN_DIR = pathlib.Path(__file__).resolve().parent / "golden"

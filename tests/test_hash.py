@@ -1,0 +1,55 @@
+"""Bit-exactness of the rjenkins1 hash vs golden vectors from the C core."""
+
+import json
+
+import numpy as np
+import pytest
+
+from conftest import GOLDEN_DIR
+
+from ceph_tpu.crush import hash as H
+
+
+@pytest.fixture(scope="module")
+def cases():
+    d = json.load(open(GOLDEN_DIR / "hash.json"))
+    return np.array(d["cases"], dtype=np.uint64)
+
+
+def test_seed():
+    assert H.CRUSH_HASH_SEED == 1315423911
+
+
+def test_numpy_vectorized(cases):
+    a = cases[:, 0].astype(np.uint32)
+    b = cases[:, 1].astype(np.uint32)
+    np.testing.assert_array_equal(H.crush_hash32(a), cases[:, 2].astype(np.uint32))
+    np.testing.assert_array_equal(H.crush_hash32_2(a, b), cases[:, 3].astype(np.uint32))
+    np.testing.assert_array_equal(H.crush_hash32_3(a, b, a ^ b), cases[:, 4].astype(np.uint32))
+    with np.errstate(over="ignore"):
+        np.testing.assert_array_equal(
+            H.crush_hash32_4(a, b, a + b, a - b), cases[:, 5].astype(np.uint32))
+        np.testing.assert_array_equal(
+            H.crush_hash32_5(a, b, a + b, a - b, a * np.uint32(3) + b),
+            cases[:, 6].astype(np.uint32))
+
+
+def test_int_fast_path(cases):
+    for row in cases[:50]:
+        a, b = int(row[0]), int(row[1])
+        assert H.hash32_int(a) == int(row[2])
+        assert H.hash32_2_int(a, b) == int(row[3])
+        assert H.hash32_3_int(a, b, a ^ b) == int(row[4])
+        assert H.hash32_4_int(a, b, a + b, a - b) == int(row[5])
+        assert H.hash32_5_int(a, b, a + b, a - b, a * 3 + b) == int(row[6])
+
+
+def test_jax_matches_numpy(cases):
+    import jax.numpy as jnp
+
+    a32 = cases[:, 0].astype(np.uint32)
+    b32 = cases[:, 1].astype(np.uint32)
+    got = H.crush_hash32_3(jnp.asarray(a32), jnp.asarray(b32),
+                           jnp.asarray(a32 ^ b32))
+    np.testing.assert_array_equal(np.asarray(got),
+                                  cases[:, 4].astype(np.uint32))

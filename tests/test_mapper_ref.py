@@ -1,0 +1,45 @@
+"""The scalar reference mapper vs every golden do_rule vector."""
+
+import json
+
+import pytest
+
+from conftest import GOLDEN_DIR
+
+from ceph_tpu.crush.map import CrushMap
+from ceph_tpu.crush.mapper_ref import crush_do_rule
+
+MAP_FILES = [
+    "map_flat12", "map_tree3", "map_tree3_chooseargs", "map_tree3_legacy",
+    "map_uniform", "map_list", "map_straw", "map_weird", "map_big10k",
+]
+
+
+def load(name):
+    d = json.load(open(GOLDEN_DIR / f"{name}.json"))
+    cmap = CrushMap.from_dict(d["map"])
+    return cmap, d
+
+
+@pytest.mark.parametrize("name", MAP_FILES)
+def test_golden_map(name):
+    cmap, d = load(name)
+    cargs = cmap.choose_args.get("golden")
+    for case in d["cases"]:
+        ruleno = case["ruleno"]
+        numrep = case["numrep"]
+        weight = case["weight"]
+        x0, x1 = case["x0"], case["x1"]
+        # keep the big map quick: every x still covered for small maps
+        step = 4 if name == "map_big10k" else 1
+        for i, x in enumerate(range(x0, x1, step)):
+            want = case["results"][x - x0]
+            got = crush_do_rule(cmap, ruleno, x, numrep, weight,
+                                choose_args=cargs)
+            assert got == want, (name, ruleno, numrep, x, got, want)
+
+
+def test_roundtrip_json():
+    cmap, d = load("map_tree3")
+    again = CrushMap.from_json(cmap.to_json())
+    assert again.to_dict() == cmap.to_dict()
