@@ -1,0 +1,125 @@
+"""Map construction helpers — the role of the reference's builder
+(src/crush/builder.c: crush_make_*_bucket, crush_add_bucket,
+crush_reweight_bucket) plus convenience constructors for synthetic
+hierarchies (crushtool --build, src/tools/crushtool.cc:135).
+
+All weights are 16.16 fixed point, as everywhere in CRUSH.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence
+
+from . import constants as C
+from .map import Bucket, CrushMap, Rule, RuleStep
+
+
+def make_straw2_bucket(items: Sequence[int], weights: Sequence[int],
+                       type_: int, bid: int = 0,
+                       hash_: int = C.CRUSH_HASH_RJENKINS1) -> Bucket:
+    """crush_make_straw2_bucket (builder.c): weights are used raw."""
+    return Bucket(id=bid, alg=C.CRUSH_BUCKET_STRAW2, type=type_,
+                  hash=hash_, items=list(items),
+                  item_weights=list(weights), weight=sum(weights))
+
+
+def make_uniform_bucket(items: Sequence[int], item_weight: int,
+                        type_: int, bid: int = 0,
+                        hash_: int = C.CRUSH_HASH_RJENKINS1) -> Bucket:
+    return Bucket(id=bid, alg=C.CRUSH_BUCKET_UNIFORM, type=type_,
+                  hash=hash_, items=list(items), item_weight=item_weight,
+                  weight=item_weight * len(items))
+
+
+def make_list_bucket(items: Sequence[int], weights: Sequence[int],
+                     type_: int, bid: int = 0,
+                     hash_: int = C.CRUSH_HASH_RJENKINS1) -> Bucket:
+    """sum_weights[i] = head prefix sum, as crush_make_list_bucket."""
+    sums, acc = [], 0
+    for w in weights:
+        acc += w
+        sums.append(acc)
+    return Bucket(id=bid, alg=C.CRUSH_BUCKET_LIST, type=type_,
+                  hash=hash_, items=list(items),
+                  item_weights=list(weights), sum_weights=sums,
+                  weight=acc)
+
+
+def make_tree_bucket(items: Sequence[int], weights: Sequence[int],
+                     type_: int, bid: int = 0,
+                     hash_: int = C.CRUSH_HASH_RJENKINS1) -> Bucket:
+    """crush_make_tree_bucket: items sit at odd node ((i+1)<<1)-1 of an
+    implicit binary tree; internal node weight = sum of its subtree."""
+    n = len(items)
+    depth = max(1, math.ceil(math.log2(n)) + 1) if n > 1 else 1
+    num_nodes = 1 << depth
+    node_weights = [0] * num_nodes
+    for i, w in enumerate(weights):
+        node = ((i + 1) << 1) - 1
+        node_weights[node] = w
+        # accumulate up: parent of node j at height h is found by
+        # clearing the lowest set bit run — walk ancestors
+        j = node
+        while True:
+            low = j & -j
+            parent = (j - low) | (low << 1)
+            if parent >= num_nodes:
+                break
+            node_weights[parent] += w
+            j = parent
+    return Bucket(id=bid, alg=C.CRUSH_BUCKET_TREE, type=type_,
+                  hash=hash_, items=list(items), num_nodes=num_nodes,
+                  node_weights=node_weights, weight=sum(weights))
+
+
+def add_simple_rule(cmap: CrushMap, root_id: int, leaf_type: int,
+                    firstn: bool = True, ruleno: int = -1,
+                    rule_type: int = 1,
+                    choose_type: Optional[int] = None) -> int:
+    """CrushWrapper::add_simple_rule (CrushWrapper.h:1167):
+    take root -> chooseleaf {firstn|indep} 0 type <leaf_type> -> emit."""
+    op = (C.CRUSH_RULE_CHOOSELEAF_FIRSTN if firstn
+          else C.CRUSH_RULE_CHOOSELEAF_INDEP)
+    steps = [RuleStep(C.CRUSH_RULE_TAKE, root_id, 0),
+             RuleStep(op, 0, leaf_type),
+             RuleStep(C.CRUSH_RULE_EMIT, 0, 0)]
+    return cmap.add_rule(Rule(steps=steps, type=rule_type), ruleno)
+
+
+def build_hierarchy(cmap: CrushMap, spec: List[tuple],
+                    device_weight: int = 0x10000) -> int:
+    """Synthetic uniform hierarchy a la ``crushtool --build``:
+    ``spec`` = [(type_id, fan_out), ...] bottom-up; level 0 children are
+    devices.  Returns the root bucket id."""
+    n_dev = 1
+    for _, fan in spec:
+        n_dev *= fan
+    level_ids = list(range(n_dev))
+    level_weights = [device_weight] * n_dev
+    for type_id, fan in spec:
+        next_ids, next_weights = [], []
+        for i in range(0, len(level_ids), fan):
+            children = level_ids[i:i + fan]
+            weights = level_weights[i:i + fan]
+            b = make_straw2_bucket(children, weights, type_id)
+            bid = cmap.add_bucket(b)
+            next_ids.append(bid)
+            next_weights.append(b.weight)
+        level_ids, level_weights = next_ids, next_weights
+    assert len(level_ids) == 1
+    cmap.max_devices = max(cmap.max_devices, n_dev)
+    return level_ids[0]
+
+
+def sample_cluster_map(racks: int = 3, hosts_per_rack: int = 4,
+                       osds_per_host: int = 4) -> CrushMap:
+    """A production-shaped 3-level straw2 map: root -> racks -> hosts ->
+    osds, with one replicated chooseleaf rule 0 and one EC indep rule 1."""
+    cmap = CrushMap()
+    root_id = build_hierarchy(
+        cmap, [(1, osds_per_host), (2, hosts_per_rack), (3, racks)])
+    add_simple_rule(cmap, root_id, leaf_type=1, firstn=True, ruleno=0)
+    add_simple_rule(cmap, root_id, leaf_type=1, firstn=False, ruleno=1,
+                    rule_type=3)
+    return cmap

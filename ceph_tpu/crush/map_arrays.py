@@ -1,0 +1,199 @@
+"""Flat array (SoA) encoding of a CrushMap for the batched TPU mapper.
+
+The reference stores the hierarchy as a pointer forest of per-alg bucket
+structs (src/crush/crush.h:219-333).  XLA wants dense, statically-shaped
+tensors, so the TPU mapper consumes this padded structure-of-arrays view
+instead: every bucket is a row, every per-item field a padded column.  Row
+index is the bucket *index* (-1 - id), matching the reference's
+``map->buckets[-1-id]`` addressing (src/crush/mapper.c:891).
+
+Split into a static shell (shapes, algs present, tunables — compile-time)
+and runtime arrays (weights, items — exchangeable without recompilation, the
+property the balancer's mutate-remap loop needs; SURVEY §7 hard part 5).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from . import constants as C
+from .map import ChooseArgMap, CrushMap
+
+
+def _pad2(rows, width, dtype, fill=0):
+    out = np.full((len(rows), width), fill, dtype=dtype)
+    for i, r in enumerate(rows):
+        if len(r):
+            out[i, :len(r)] = r
+    return out
+
+
+@dataclass(frozen=True)
+class MapStatic:
+    """Compile-time facts about a map (hashable; part of the jit key)."""
+
+    max_buckets: int
+    max_devices: int
+    max_size: int        # padded item width S
+    max_nodes: int       # padded tree-node width
+    max_positions: int   # padded choose_args weight_set positions
+    algs_present: Tuple[int, ...]
+    has_uniform: bool
+    has_choose_args: bool
+    tunables: Tuple[int, int, int, int, int, int]
+
+
+@dataclass
+class MapArrays:
+    """Runtime (device-resident) view of the map.  A pytree of arrays; pass
+    through jit as an argument so weight mutations don't recompile."""
+
+    alg: np.ndarray            # i32[B]   0 = no bucket at this index
+    btype: np.ndarray          # i32[B]
+    bhash: np.ndarray          # i32[B]
+    size: np.ndarray           # i32[B]
+    bid: np.ndarray            # i32[B]   the bucket id (-1-index)
+    nnodes: np.ndarray         # i32[B]   tree-bucket num_nodes
+    items: np.ndarray          # i32[B,S]
+    weights: np.ndarray        # u32[B,S] 16.16 per-item weights (uniform: broadcast)
+    sum_weights: np.ndarray    # u32[B,S] list-bucket tail prefix sums
+    straws: np.ndarray         # u32[B,S] legacy straw scale factors
+    node_weights: np.ndarray   # u32[B,N] tree-bucket node weights
+    arg_ids: np.ndarray        # i32[B,S] choose_args id substitution
+    arg_weights: np.ndarray    # u32[B,P,S] choose_args weight_set
+    has_arg: np.ndarray        # bool[B]
+
+    def tree_flatten(self):
+        return (
+            (self.alg, self.btype, self.bhash, self.size, self.bid,
+             self.nnodes, self.items, self.weights, self.sum_weights,
+             self.straws, self.node_weights, self.arg_ids,
+             self.arg_weights, self.has_arg), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        del aux
+        return cls(*children)
+
+
+def _register_pytree():
+    import jax
+
+    jax.tree_util.register_pytree_node(
+        MapArrays,
+        lambda m: m.tree_flatten(),
+        lambda aux, ch: MapArrays.tree_unflatten(aux, ch))
+
+
+try:  # register lazily-tolerant: numpy-only users never import jax
+    _register_pytree()
+except Exception:  # pragma: no cover
+    pass
+
+
+def encode_map(cmap: CrushMap,
+               choose_args: Optional[ChooseArgMap] = None,
+               ) -> Tuple[MapStatic, MapArrays]:
+    """Lower a host CrushMap (+ optional choose_args set) to the SoA view."""
+    B = cmap.max_buckets
+    bkts: Dict[int, object] = cmap.buckets
+
+    sizes = [bkts[i].size if i in bkts else 0 for i in range(B)]
+    S = max([1] + sizes)
+    max_nodes = max([1] + [bkts[i].num_nodes for i in bkts
+                           if bkts[i].alg == C.CRUSH_BUCKET_TREE])
+
+    max_pos = 1
+    if choose_args:
+        for a in choose_args.values():
+            if a.weight_set is not None:
+                max_pos = max(max_pos, len(a.weight_set))
+
+    alg = np.zeros(B, np.int32)
+    btype = np.zeros(B, np.int32)
+    bhash = np.zeros(B, np.int32)
+    size = np.zeros(B, np.int32)
+    bid = np.zeros(B, np.int32)
+    nnodes = np.zeros(B, np.int32)
+    items_rows, w_rows, sw_rows, straw_rows, node_rows = [], [], [], [], []
+    arg_id_rows = []
+    arg_w = np.zeros((B, max_pos, S), np.uint32)
+    has_arg = np.zeros(B, bool)
+
+    for i in range(B):
+        b = bkts.get(i)
+        if b is None:
+            items_rows.append([])
+            w_rows.append([])
+            sw_rows.append([])
+            straw_rows.append([])
+            node_rows.append([])
+            arg_id_rows.append([])
+            continue
+        alg[i] = b.alg
+        btype[i] = b.type
+        bhash[i] = b.hash
+        size[i] = b.size
+        bid[i] = b.id
+        nnodes[i] = b.num_nodes
+        items_rows.append(b.items)
+        if b.alg == C.CRUSH_BUCKET_UNIFORM:
+            w_rows.append([b.item_weight] * b.size)
+        else:
+            w_rows.append(b.item_weights)
+        sw_rows.append(b.sum_weights)
+        straw_rows.append(b.straws)
+        node_rows.append(b.node_weights)
+
+        ids = list(b.items)
+        wts = None
+        if choose_args is not None:
+            a = choose_args.get(i)
+            if a is not None:
+                has_arg[i] = True
+                if a.ids is not None:
+                    ids = list(a.ids)
+                if a.weight_set is not None:
+                    for p in range(max_pos):
+                        row = a.weight_set[min(p, len(a.weight_set) - 1)]
+                        arg_w[i, p, :len(row)] = row
+                    wts = True
+        if wts is None:
+            row = w_rows[-1]
+            arg_w[i, :, :len(row)] = np.asarray(row, np.uint32)[None, :]
+        arg_id_rows.append(ids)
+
+    static = MapStatic(
+        max_buckets=B,
+        max_devices=cmap.max_devices,
+        max_size=S,
+        max_nodes=max_nodes,
+        max_positions=max_pos,
+        algs_present=tuple(sorted(set(int(a) for a in alg if a))),
+        has_uniform=C.CRUSH_BUCKET_UNIFORM in alg,
+        has_choose_args=bool(choose_args),
+        tunables=(
+            cmap.tunables.choose_local_tries,
+            cmap.tunables.choose_local_fallback_tries,
+            cmap.tunables.choose_total_tries,
+            cmap.tunables.chooseleaf_descend_once,
+            cmap.tunables.chooseleaf_vary_r,
+            cmap.tunables.chooseleaf_stable,
+        ),
+    )
+    arrays = MapArrays(
+        alg=alg, btype=btype, bhash=bhash, size=size, bid=bid,
+        nnodes=nnodes,
+        items=_pad2(items_rows, S, np.int32),
+        weights=_pad2(w_rows, S, np.uint32),
+        sum_weights=_pad2(sw_rows, S, np.uint32),
+        straws=_pad2(straw_rows, S, np.uint32),
+        node_weights=_pad2(node_rows, max_nodes, np.uint32),
+        arg_ids=_pad2(arg_id_rows, S, np.int32),
+        arg_weights=arg_w,
+        has_arg=has_arg,
+    )
+    return static, arrays

@@ -3,18 +3,26 @@
 Tests run hermetically on CPU with 8 virtual XLA devices so every multi-chip
 sharding path (pjit/shard_map over a Mesh) is exercised without TPU hardware;
 the driver separately compile-checks the real-chip path via __graft_entry__.
-Must run before anything imports jax.
+
+The environment may preload jax and pin JAX_PLATFORMS to a hardware backend
+before pytest ever runs, so plain env-var setdefault is NOT enough: force the
+platform through jax.config (honored until the first backend client is
+created) and inject the virtual-device XLA flag before any client exists.
 """
 
 import os
 import pathlib
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
