@@ -1,0 +1,122 @@
+"""Framework benchmark — prints ONE JSON line with the headline metric.
+
+Headline: CRUSH placement throughput (mappings/s) on the 10k-OSD
+3-level straw2 map, numrep=3 chooseleaf — the exact workload of the
+reference's `crushtool --test` hot loop (src/crush/CrushTester.cc:573
+calling crush_do_rule, src/crush/mapper.c:878), whose single-thread CPU
+rate was measured in-container from the reference's own C core:
+85099.6 mappings/s (BASELINE_MEASURED.json).  vs_baseline is the
+speedup over that number; the BASELINE.json target is 50x.
+
+Runs on whatever jax.devices() provides (TPU under the driver).
+Secondary metrics (EC encode GB/s) go to stderr so stdout stays one line.
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+REPO = pathlib.Path(__file__).resolve().parent
+
+CPU_BASELINE_MAPPINGS_PER_SEC = json.load(
+    open(REPO / "BASELINE_MEASURED.json"))["crush_mappings_per_sec_cpu"]
+
+
+def bench_crush(batch=None, iters=None):
+    import jax
+    import jax.numpy as jnp
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    if batch is None:
+        batch = (1 << 17) if on_accel else (1 << 13)
+    if iters is None:
+        iters = 8 if on_accel else 2
+
+    from ceph_tpu.crush.map import CrushMap
+    from ceph_tpu.crush.mapper_jax import build_rule_fn
+
+    d = json.load(open(REPO / "tests/golden/map_big10k.json"))
+    cmap = CrushMap.from_dict(d["map"])
+    case = d["cases"][0]
+    fn, static, arrays = build_rule_fn(cmap, case["ruleno"],
+                                       case["numrep"])
+    A = jax.tree_util.tree_map(jnp.asarray, arrays)
+    weight = jnp.asarray(np.asarray(case["weight"], np.uint32))
+
+    xs = jnp.arange(batch, dtype=jnp.uint32)
+    res, lens = fn(A, weight, xs)  # compile + warm
+    res.block_until_ready()
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        xs_i = jnp.arange(i * batch, (i + 1) * batch, dtype=jnp.uint32)
+        res, lens = fn(A, weight, xs_i)
+    res.block_until_ready()
+    dt = time.perf_counter() - t0
+    rate = batch * iters / dt
+
+    # cross-check a slice against the golden vectors
+    n = min(256, case["x1"] - case["x0"])
+    gres, glens = fn(A, weight,
+                     jnp.arange(case["x0"], case["x0"] + n,
+                                dtype=jnp.uint32))
+    gres = np.asarray(gres)
+    glens = np.asarray(glens)
+    for i in range(n):
+        want = case["results"][i]
+        got = list(gres[i, :glens[i]])
+        assert got == want, f"golden mismatch at x={case['x0'] + i}"
+    return rate
+
+
+def bench_ec(k=8, m=3, chunk=None, batch=4, iters=8):
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.ec.rs_jax import RSCode
+
+    if chunk is None:
+        chunk = (1 << 20) if jax.devices()[0].platform != "cpu" \
+            else (1 << 16)
+    code = RSCode(k, m)
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.integers(0, 256, (k, batch * chunk),
+                                    dtype=np.uint8))
+    out = code.encode(data)
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = code.encode(data)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    gbps = (k * batch * chunk * iters) / dt / 1e9
+    return gbps
+
+
+def main():
+    from ceph_tpu.utils.platform import apply_platform_env
+
+    apply_platform_env()  # CEPH_TPU_PLATFORM=cpu forces the CPU backend
+    import jax
+
+    dev = jax.devices()[0].platform
+    rate = bench_crush()
+    try:
+        ec_gbps = bench_ec()
+        print(f"# ec_encode k=8,m=3: {ec_gbps:.2f} GB/s on {dev}",
+              file=sys.stderr)
+    except Exception as e:  # EC is secondary; never break the one line
+        print(f"# ec bench failed: {e}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "crush_mappings_per_sec",
+        "value": round(rate, 1),
+        "unit": "mappings/s",
+        "vs_baseline": round(rate / CPU_BASELINE_MAPPINGS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

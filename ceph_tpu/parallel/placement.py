@@ -1,0 +1,78 @@
+"""Multi-chip placement + EC step — the framework's distribution layer.
+
+The reference scales by sharding PGs across OSD processes and fanning
+EC chunks across shard OSDs over its AsyncMessenger TCP fabric
+(src/osd/OSDMapMapping.h:18 thread-pool PG batching;
+src/osd/ECBackend.cc:934 chunk fan-out; src/msg/async/* transport).
+The TPU-native re-expression (SURVEY §2.6): the PG axis is data-parallel
+over the device mesh, the EC stripe byte axis is the sequence-parallel
+axis, and all cross-chip movement is XLA collectives over ICI — an
+all-reduce for cluster-wide utilization tallies, an all-gather when the
+full placement table must be host-visible.  No NCCL/MPI translation; the
+mesh + shardings ARE the communication backend.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..crush.map import ChooseArgMap, CrushMap
+from ..crush.mapper_jax import build_rule_fn
+
+
+def make_mesh(devices: Optional[Sequence] = None,
+              axis_name: str = "pg") -> Mesh:
+    """A 1-D mesh over the PG (data) axis — the framework's default
+    topology, matching how the reference shards everything by PG."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def utilization(results, lens, max_devices: int):
+    """Per-OSD placement tallies — the CrushTester stats pass
+    (src/crush/CrushTester.cc:588-648) as one scatter-add."""
+    R = results.shape[-1]
+    pos = jnp.arange(R, dtype=jnp.int32)
+    valid = (pos[None, :] < lens[:, None]) & (results >= 0) \
+        & (results < max_devices)
+    flat = jnp.where(valid, results, max_devices)
+    counts = jnp.zeros(max_devices + 1, jnp.int32).at[flat].add(1)
+    return counts[:max_devices]
+
+
+def sharded_rule_fn(cmap: CrushMap, ruleno: int, result_max: int,
+                    mesh: Mesh, axis_name: str = "pg",
+                    choose_args: Optional[ChooseArgMap] = None,
+                    gather_stats: bool = True):
+    """Compile the batched mapper sharded over ``mesh``.
+
+    Returns ``fn(arrays, weight, xs)`` where ``xs`` is sharded on the PG
+    axis, the map arrays and weight vector are replicated (they are the
+    cluster map — every chip holds it, exactly as every OSD/client holds
+    the OSDMap), results stay PG-sharded, and the utilization tally is
+    all-reduced to every chip.
+    """
+    fn, static, arrays = build_rule_fn(cmap, ruleno, result_max,
+                                       choose_args)
+    repl = NamedSharding(mesh, P())
+    shard = NamedSharding(mesh, P(axis_name))
+
+    def step(A, weight, xs):
+        res, lens = fn(A, weight, xs)
+        if gather_stats:
+            counts = utilization(res, lens, static.max_devices)
+            return res, lens, counts
+        return res, lens
+
+    out_sh = (shard, shard, repl) if gather_stats else (shard, shard)
+    sharded = jax.jit(
+        step,
+        in_shardings=(repl, repl, shard),
+        out_shardings=out_sh)
+    return sharded, static, arrays

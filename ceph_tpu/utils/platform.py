@@ -1,0 +1,18 @@
+"""Platform selection helper.
+
+Some deployment images preload jax and pin ``jax_platforms`` to a
+hardware backend at interpreter start, which makes the standard
+``JAX_PLATFORMS`` env var a no-op.  ``apply_platform_env()`` restores
+user control: set ``CEPH_TPU_PLATFORM=cpu`` (or any backend name) to
+override via jax.config before the first backend client is created.
+"""
+
+import os
+
+
+def apply_platform_env() -> None:
+    plat = os.environ.get("CEPH_TPU_PLATFORM")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
