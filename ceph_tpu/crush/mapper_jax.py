@@ -578,16 +578,13 @@ def make_choose_indep(rc: _RuleCompiler, *, numrep: int, type_: int,
     return run
 
 
-def build_rule_fn(cmap: CrushMap, ruleno: int, result_max: int,
-                  choose_args: Optional[ChooseArgMap] = None,
-                  encoded=None):
-    """Compile one rule into a batched mapper.
-
-    Returns ``(fn, static, arrays)`` where ``fn(arrays, weight_u32[D],
-    xs_u32[N]) -> (results i32[N, result_max], lens i32[N])`` is jitted;
-    pass updated ``arrays``/``weight`` freely — only shape changes
-    recompile.  This is the TPU replacement for the reference hot loop at
-    CrushTester.cc:573 / OSDMapMapping.h:18.
+def make_single_fn(cmap: CrushMap, ruleno: int, result_max: int,
+                   choose_args: Optional[ChooseArgMap] = None,
+                   encoded=None):
+    """The unjitted single-x rule program: ``single(arrays, weight, x)
+    -> (result i32[R], len i32)``.  Compose/fuse it into larger programs
+    (the OSDMap pipeline) before vmap+jit.  Returns
+    ``(single, static, arrays_np)``.
 
     ``encoded``: a pre-computed ``encode_map`` result, so callers
     compiling many rules over one map pay the host-side encode once.
@@ -743,6 +740,22 @@ def build_rule_fn(cmap: CrushMap, ruleno: int, result_max: int,
                 wbound = 0
         return result, rlen
 
+    return single, static, arrays_np
+
+
+def build_rule_fn(cmap: CrushMap, ruleno: int, result_max: int,
+                  choose_args: Optional[ChooseArgMap] = None,
+                  encoded=None):
+    """Compile one rule into a batched mapper.
+
+    Returns ``(fn, static, arrays)`` where ``fn(arrays, weight_u32[D],
+    xs_u32[N]) -> (results i32[N, result_max], lens i32[N])`` is jitted;
+    pass updated ``arrays``/``weight`` freely — only shape changes
+    recompile.  This is the TPU replacement for the reference hot loop at
+    CrushTester.cc:573 / OSDMapMapping.h:18.
+    """
+    single, static, arrays_np = make_single_fn(
+        cmap, ruleno, result_max, choose_args, encoded)
     batched = jax.jit(jax.vmap(single, in_axes=(None, None, 0)))
     return batched, static, arrays_np
 
