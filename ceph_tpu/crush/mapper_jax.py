@@ -275,12 +275,17 @@ class _RuleCompiler:
     # -- device rejection ---------------------------------------------
     def is_out(self, weight, item, x):
         """Weight-based rejection (mapper.c:402-416); item is a valid
-        device id when this is called."""
-        w = weight[jnp.clip(item, 0, self.st.max_devices - 1)]
+        device id when this is called.  The weight vector is the
+        caller's runtime array and its length is the C ``weight_max``
+        bound: items at or past it are out (mapper.c:406), never a
+        clamped gather into the last slot."""
+        wmax = weight.shape[0]
+        w = weight[jnp.clip(item, 0, wmax - 1)]
         h = _h2(jnp.int32(C.CRUSH_HASH_RJENKINS1), x, item) \
             & jnp.uint32(0xFFFF)
-        return jnp.where(w >= 0x10000, False,
-                         jnp.where(w == 0, True, h >= w))
+        return jnp.where(item >= wmax, True,
+                         jnp.where(w >= 0x10000, False,
+                                   jnp.where(w == 0, True, h >= w)))
 
     # -- child bucket classification ----------------------------------
     def classify(self, A, item):

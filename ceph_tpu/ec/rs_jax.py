@@ -12,8 +12,9 @@ and applied as an integer matmul mod 2:
     parity planes  = (BM_i8 @ planes_i8) & 1     (MXU int8 matmul)
     parity u8[m, L] ← pack bit planes
 
-The contraction depth is 8k <= 2048 < 2^8, so int8 accumulation into i32
-is exact.  Decode is the same matmul with a host-inverted matrix
+Per-element products are 0/1, so the i32 accumulator
+(preferred_element_type=int32) holds at most the contraction depth
+8k <= 2048 << 2^31 — exact.  Decode is the same matmul with a host-inverted matrix
 (gf.decode_matrix), mirroring the reference's decode-table flow
 (ErasureCodeIsa.cc:227-304) including the LRU cache keyed by erasure
 signature (ErasureCodeIsaTableCache.cc).

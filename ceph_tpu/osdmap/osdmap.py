@@ -185,14 +185,13 @@ class OSDMap:
                      raw: List[int]) -> List[int]:
         p = self.pg_upmap.get(pgid)
         if p is not None:
-            ok = True
             for osd in p:
                 if osd != CRUSH_ITEM_NONE and 0 <= osd < self.max_osd \
                         and self.osd_weight[osd] == 0:
-                    ok = False
-                    break
-            if ok:
-                raw = list(p)
+                    # reject/ignore the explicit mapping entirely —
+                    # pg_upmap_items are skipped too (OSDMap.cc:2472)
+                    return raw
+            raw = list(p)
         q = self.pg_upmap_items.get(pgid)
         if q is not None:
             for frm, to in q:

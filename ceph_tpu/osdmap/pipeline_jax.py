@@ -190,13 +190,17 @@ class PoolMapper:
                 raw, rlen = _mask_none(raw, ex, rlen, R)
 
             # _apply_upmap (OSDMap.cc:2463)
+            upmap_rejected = jnp.bool_(False)
             if tabs.upmap is not None:
                 urow, ulen = trow["upmap"], trow["upmap_len"]
                 uvalid = (urow != NONE) & (urow >= 0) & (urow < D)
                 marked_out = uvalid & \
                     (weight[jnp.clip(urow, 0, D - 1)] == 0) & \
                     (idx < ulen)
-                use = (ulen >= 0) & ~jnp.any(marked_out)
+                # a marked-out target rejects the whole exception entry
+                # AND skips pg_upmap_items for this PG (OSDMap.cc:2472)
+                upmap_rejected = (ulen >= 0) & jnp.any(marked_out)
+                use = (ulen >= 0) & ~upmap_rejected
                 raw = jnp.where(use,
                                 jnp.where(idx < ulen, urow, NONE), raw)
                 rlen = jnp.where(use, ulen, rlen)
@@ -214,7 +218,8 @@ class PoolMapper:
                         (weight[jnp.clip(to, 0, D - 1)] == 0)
                     cand = in_seg & (raw == frm) & ~to_out
                     pos = jnp.argmax(cand)
-                    do = active & ~has_to & jnp.any(cand)
+                    do = active & ~has_to & jnp.any(cand) \
+                        & ~upmap_rejected
                     raw = jnp.where(
                         do, raw.at[pos].set(to), raw)
 
