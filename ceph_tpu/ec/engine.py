@@ -1,0 +1,213 @@
+"""The TPU EC execution engine: every code family as one mod-2 matmul.
+
+The reference executes EC three different ways — isa-l's table-driven
+SSE/AVX GF multiplies (ErasureCodeIsa.cc:129 ec_encode_data), jerasure's
+matrix loops, and jerasure's bitmatrix XOR schedules
+(jerasure_schedule_encode, ErasureCodeJerasure.cc:264).  None of those
+map to a TPU.  What does: every one of these codes is GF(2)-linear, so
+encode/decode is a single 0/1 matrix applied over bit rows — an int8
+matmul on the MXU with a mod-2 epilogue.  Three data layouts cover the
+whole zoo:
+
+- ``w8``  — GF(2^8) matrix codes: chunk bytes → 8 bit planes.
+- ``w16/w32`` — GF(2^16/2^32) RS: chunk viewed as little-endian words →
+  w bit planes (matches jerasure's word-in-memory convention).
+- ``packet(w, psize)`` — bitmatrix/schedule codes (cauchy, liberation,
+  blaum_roth, liber8tion): chunk = blocks of w packets of psize bytes;
+  packet-rows are the GF(2) vector elements; bytes XOR bitwise, so the
+  byte axis is unpacked to bits for the matmul and repacked after.
+
+Encode: parity_rows = CB @ data_rows (CB = coding bitmatrix, w*m x w*k).
+Decode: pick k surviving chunks, stack their row-blocks of the full
+[I; CB] matrix, invert over GF(2) on host (cached per erasure
+signature — the ErasureCodeIsaTableCache flow, ErasureCodeIsa.cc:227),
+one matmul recovers all data rows; missing parity is re-encoded.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .gfw import gf2_mat_inv
+
+_BITS8 = np.arange(8, dtype=np.uint8)
+
+
+@jax.jit
+def _mod2_matmul(bm, planes):
+    """(R, C) 0/1 int8 @ (C, N) 0/1 int8 -> (R, N) 0/1 uint8.
+    Products are 0/1 and C <= a few thousand << 2^31, so the i32
+    accumulator is exact; the &1 is the mod-2 epilogue XLA fuses."""
+    acc = jax.lax.dot_general(
+        bm.astype(jnp.int8), planes.astype(jnp.int8),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return (acc & 1).astype(jnp.uint8)
+
+
+def _unpack_bytes(data):
+    """u8[r, L] -> 0/1 u8[8r, L], row-major (row, bit), LSB first."""
+    r, L = data.shape
+    planes = (data[:, None, :] >> _BITS8[None, :, None]) & jnp.uint8(1)
+    return planes.reshape(8 * r, L)
+
+
+def _pack_bytes(planes):
+    """0/1 u8[8r, L] -> u8[r, L]."""
+    r8, L = planes.shape
+    p = planes.reshape(r8 // 8, 8, L)
+    return jnp.sum(p << _BITS8[None, :, None], axis=1, dtype=jnp.uint8)
+
+
+class Layout:
+    """Chunk bytes <-> GF(2) row-block transform for one code family."""
+
+    def __init__(self, w: int, packetsize: int = 0):
+        self.w = w
+        self.packetsize = packetsize
+        self.is_packet = packetsize > 0
+
+    def check(self, L: int):
+        if self.is_packet:
+            blk = self.w * self.packetsize
+            if L % blk:
+                raise ValueError(
+                    f"chunk size {L} not a multiple of w*packetsize={blk}")
+        else:
+            if L % (self.w // 8):
+                raise ValueError(
+                    f"chunk size {L} not a multiple of word size "
+                    f"{self.w // 8}")
+
+    def to_rows(self, chunks):
+        """u8[n, L] -> 0/1 u8[n*w, N]: each chunk becomes w GF(2) rows."""
+        n, L = chunks.shape
+        w = self.w
+        if self.is_packet:
+            # packet-rows of bytes; the byte's bit axis folds into N so
+            # the matmul XORs whole packets bitwise
+            ps = self.packetsize
+            nb = L // (w * ps)
+            r = chunks.reshape(n, nb, w, ps).transpose(0, 2, 1, 3)
+            r = r.reshape(n * w, nb * ps)
+            bits = (r[:, None, :] >> _BITS8[None, :, None]) & jnp.uint8(1)
+            return bits.reshape(n * w, 8 * nb * ps)
+        if w == 8:
+            return _unpack_bytes(chunks)
+        # little-endian words: byte b of a word carries bits 8b..8b+7
+        wb = w // 8
+        nw = L // wb
+        words = chunks.reshape(n, nw, wb)
+        planes = (words[:, :, :, None] >> _BITS8[None, None, None, :]) \
+            & jnp.uint8(1)
+        # [n, nw, wb, 8] -> [n, w, nw] rows (bit index = 8*byte + bit)
+        return planes.transpose(0, 2, 3, 1).reshape(n * w, nw)
+
+    def from_rows(self, rows, n: int, L: int):
+        """Inverse of to_rows for n chunks of L bytes."""
+        w = self.w
+        if self.is_packet:
+            ps = self.packetsize
+            nb = L // (w * ps)
+            bits = rows.reshape(n * w, 8, nb * ps)
+            by = jnp.sum(bits << _BITS8[None, :, None], axis=1,
+                         dtype=jnp.uint8)
+            by = by.reshape(n, w, nb, ps).transpose(0, 2, 1, 3)
+            return by.reshape(n, L)
+        if w == 8:
+            return _pack_bytes(rows)
+        wb = w // 8
+        nw = L // wb
+        planes = rows.reshape(n, wb, 8, nw).transpose(0, 3, 1, 2)
+        by = jnp.sum(planes << _BITS8[None, None, None, :], axis=3,
+                     dtype=jnp.uint8)
+        return by.reshape(n, L)
+
+
+class BitCode:
+    """A systematic GF(2)-linear code executed as MXU matmuls.
+
+    ``coding_bm``: (w*m, w*k) 0/1 coding bitmatrix (rows produce the m
+    parity chunks' row-blocks from the k data chunks' row-blocks).
+    """
+
+    def __init__(self, k: int, m: int, coding_bm: np.ndarray,
+                 layout: Layout):
+        self.k, self.m = k, m
+        self.layout = layout
+        w = layout.w
+        assert coding_bm.shape == (w * m, w * k), coding_bm.shape
+        self.coding_bm = np.asarray(coding_bm, np.uint8) & 1
+        full = np.concatenate(
+            [np.eye(w * k, dtype=np.uint8), self.coding_bm], axis=0)
+        self.full_bm = full                      # ((k+m)w, kw)
+        self._enc_dev = jnp.asarray(self.coding_bm)
+        self._dec_cache: Dict[Tuple[int, ...], tuple] = {}
+
+    # -- encode -------------------------------------------------------
+    def encode(self, data):
+        """u8[k, L] -> parity u8[m, L]."""
+        data = jnp.asarray(data)
+        assert data.shape[0] == self.k
+        self.layout.check(data.shape[1])
+        rows = self.layout.to_rows(data)
+        out = _mod2_matmul(self._enc_dev, rows)
+        return self.layout.from_rows(out, self.m, data.shape[1])
+
+    def all_chunks(self, data):
+        data = jnp.asarray(data)
+        return jnp.concatenate([data, self.encode(data)], axis=0)
+
+    # -- decode -------------------------------------------------------
+    def _decode_mats(self, present: Tuple[int, ...]):
+        """Host-inverted GF(2) decode matrix for k survivors, cached by
+        erasure signature (the IsaTableCache flow)."""
+        mats = self._dec_cache.get(present)
+        if mats is None:
+            w = self.layout.w
+            rows = np.concatenate(
+                [self.full_bm[c * w:(c + 1) * w] for c in present], axis=0)
+            inv = gf2_mat_inv(rows)
+            mats = (jnp.asarray(inv),)
+            if len(self._dec_cache) >= 512:   # LRU-ish bound
+                self._dec_cache.pop(next(iter(self._dec_cache)))
+            self._dec_cache[present] = mats
+        return mats
+
+    def decode_data(self, chunks: Dict[int, "jnp.ndarray"]):
+        """Recover all k data chunks from any k available chunks.
+        ``chunks``: {chunk_id: u8[L]}."""
+        avail = sorted(chunks)
+        if len(avail) < self.k:
+            raise ValueError("need at least k chunks")
+        present = tuple(avail[:self.k])
+        (inv,) = self._decode_mats(present)
+        stack = jnp.stack([jnp.asarray(chunks[i]) for i in present])
+        L = stack.shape[1]
+        self.layout.check(L)
+        rows = self.layout.to_rows(stack)
+        out = _mod2_matmul(inv, rows)
+        return self.layout.from_rows(out, self.k, L)
+
+    def decode(self, want: Sequence[int], chunks: Dict[int, "jnp.ndarray"]):
+        """Reconstruct the wanted chunk ids (data and/or parity).
+        Returns {chunk_id: u8[L]}."""
+        have = dict(chunks)
+        missing = [i for i in want if i not in have]
+        if missing:
+            data = self.decode_data(have)
+            for i in range(self.k):
+                if i not in have:
+                    have[i] = data[i]
+            par_missing = [i for i in missing if i >= self.k]
+            if par_missing:
+                parity = self.encode(data)
+                for i in par_missing:
+                    have[i] = parity[i - self.k]
+        return {i: have[i] for i in want}

@@ -1,0 +1,229 @@
+"""The ErasureCode interface contract and base-class semantics.
+
+Mirrors the reference's load-bearing EC seam — the ~12-virtual
+``ErasureCodeInterface`` (src/erasure-code/ErasureCodeInterface.h:170-462)
+plus the shared behavior of the ``ErasureCode`` base class
+(src/erasure-code/ErasureCode.cc:42-348): profile init, chunk
+``mapping=`` remap, aligned ``encode_prepare`` padding, trivial-copy
+decode, default ``minimum_to_decode``.  Chunk payloads are numpy/JAX
+uint8 arrays instead of bufferlists; plugins put the math on the TPU via
+``ceph_tpu.ec.engine``.
+
+An object of size S is carved into k data chunks of
+``get_chunk_size(S)`` bytes (zero-padded) plus m coding chunks; chunk i
+of the *encoded* layout holds object range
+[i*chunk_size, (i+1)*chunk_size) — the diagram at
+ErasureCodeInterface.h:39-74.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+ErasureCodeProfile = Dict[str, str]
+
+SIMD_ALIGN = 32  # ErasureCode.cc:42 — kept for layout parity
+
+DEFAULT_RULE_ROOT = "default"
+DEFAULT_RULE_FAILURE_DOMAIN = "host"
+
+
+class ErasureCodeError(Exception):
+    def __init__(self, errno_: int, msg: str):
+        super().__init__(msg)
+        self.errno = errno_
+
+
+class ErasureCode:
+    """Base class: everything but the code-specific matrix."""
+
+    def __init__(self):
+        self.chunk_mapping: List[int] = []
+        self._profile: ErasureCodeProfile = {}
+        self.rule_root = DEFAULT_RULE_ROOT
+        self.rule_failure_domain = DEFAULT_RULE_FAILURE_DOMAIN
+        self.rule_device_class = ""
+
+    # -- profile ------------------------------------------------------
+    def init(self, profile: ErasureCodeProfile) -> None:
+        """Parse the profile; raises ErasureCodeError on bad input
+        (the reference returns -EINVAL + fills *ss)."""
+        self.rule_root = profile.get("crush-root", DEFAULT_RULE_ROOT)
+        self.rule_failure_domain = profile.get(
+            "crush-failure-domain", DEFAULT_RULE_FAILURE_DOMAIN)
+        self.rule_device_class = profile.get("crush-device-class", "")
+        self._parse_mapping(profile)
+        self._profile = dict(profile)
+
+    def get_profile(self) -> ErasureCodeProfile:
+        return self._profile
+
+    def _parse_mapping(self, profile: ErasureCodeProfile) -> None:
+        """profile ``mapping=DD_D...``: data chunks go to the 'D'
+        positions, coding chunks to the rest (ErasureCode.cc:260-279)."""
+        mapping = profile.get("mapping")
+        if not mapping:
+            return
+        data_pos = [i for i, c in enumerate(mapping) if c == "D"]
+        coding_pos = [i for i, c in enumerate(mapping) if c != "D"]
+        self.chunk_mapping = data_pos + coding_pos
+
+    def chunk_index(self, i: int) -> int:
+        return self.chunk_mapping[i] if i < len(self.chunk_mapping) else i
+
+    @staticmethod
+    def sanity_check_k_m(k: int, m: int) -> None:
+        if k < 2:
+            raise ErasureCodeError(-22, f"k={k} must be >= 2")
+        if m < 1:
+            raise ErasureCodeError(-22, f"m={m} must be >= 1")
+
+    # -- geometry (code-specific) --------------------------------------
+    def get_chunk_count(self) -> int:
+        raise NotImplementedError
+
+    def get_data_chunk_count(self) -> int:
+        raise NotImplementedError
+
+    def get_coding_chunk_count(self) -> int:
+        return self.get_chunk_count() - self.get_data_chunk_count()
+
+    def get_sub_chunk_count(self) -> int:
+        return 1
+
+    def get_chunk_size(self, object_size: int) -> int:
+        raise NotImplementedError
+
+    # -- CRUSH rule ----------------------------------------------------
+    def create_rule(self, name: str, crush) -> int:
+        """add_simple_rule(root, failure-domain, class, "indep")
+        (ErasureCode.cc:64-82); ``crush`` is a CrushWrapper."""
+        return crush.add_simple_rule(
+            name, self.rule_root, self.rule_failure_domain,
+            self.rule_device_class, "indep", rule_type=3)
+
+    # -- minimum_to_decode --------------------------------------------
+    def _minimum_to_decode(self, want_to_read: Set[int],
+                           available: Set[int]) -> Set[int]:
+        """Default: wanted chunks if all available, else the first k
+        available (ErasureCode.cc:102-119)."""
+        if want_to_read <= available:
+            return set(want_to_read)
+        k = self.get_data_chunk_count()
+        if len(available) < k:
+            raise ErasureCodeError(-5, "not enough chunks to decode")
+        return set(sorted(available)[:k])
+
+    def minimum_to_decode(
+            self, want_to_read: Set[int], available: Set[int]
+    ) -> Dict[int, List[Tuple[int, int]]]:
+        """chunk id -> [(sub_chunk_offset, count)]
+        (ErasureCode.cc:121-137)."""
+        ids = self._minimum_to_decode(set(want_to_read), set(available))
+        sub = [(0, self.get_sub_chunk_count())]
+        return {i: list(sub) for i in sorted(ids)}
+
+    def minimum_to_decode_with_cost(
+            self, want_to_read: Set[int],
+            available: Dict[int, int]) -> Set[int]:
+        """Equal-cost default (ErasureCode.cc:139-148)."""
+        return self._minimum_to_decode(set(want_to_read),
+                                       set(available.keys()))
+
+    # -- encode -------------------------------------------------------
+    def encode_prepare(self, raw: bytes | np.ndarray) -> np.ndarray:
+        """Split + zero-pad into k aligned data chunks
+        (ErasureCode.cc:150-185).  Returns u8[k, chunk_size]."""
+        raw = np.frombuffer(raw, np.uint8) if isinstance(raw, (bytes,
+                                                               bytearray)) \
+            else np.asarray(raw, np.uint8).ravel()
+        k = self.get_data_chunk_count()
+        blocksize = self.get_chunk_size(len(raw))
+        out = np.zeros((k, blocksize), np.uint8)
+        flat = out.reshape(-1)
+        flat[:len(raw)] = raw
+        return out
+
+    def encode(self, want_to_encode: Iterable[int],
+               raw: bytes | np.ndarray) -> Dict[int, np.ndarray]:
+        """Full encode flow (ErasureCode.cc:187-203): prepare, run the
+        code, return only the wanted chunks keyed by *encoded* index
+        (mapping applied)."""
+        want = set(want_to_encode)
+        data = self.encode_prepare(raw)
+        k = self.get_data_chunk_count()
+        n = self.get_chunk_count()
+        chunks: Dict[int, np.ndarray] = {
+            self.chunk_index(i): data[i] for i in range(k)}
+        for i in range(k, n):
+            chunks[self.chunk_index(i)] = np.zeros(data.shape[1], np.uint8)
+        self.encode_chunks(want, chunks)
+        return {i: chunks[i] for i in want if i in chunks}
+
+    def encode_chunks(self, want_to_encode: Set[int],
+                      chunks: Dict[int, np.ndarray]) -> None:
+        raise NotImplementedError
+
+    # -- decode -------------------------------------------------------
+    def decode(self, want_to_read: Iterable[int],
+               chunks: Dict[int, np.ndarray],
+               chunk_size: int = 0) -> Dict[int, np.ndarray]:
+        return self._decode(set(want_to_read), chunks)
+
+    def _decode(self, want_to_read: Set[int],
+                chunks: Dict[int, np.ndarray]) -> Dict[int, np.ndarray]:
+        """Trivial copy when everything wanted is present, else
+        decode_chunks (ErasureCode.cc:205-241)."""
+        have = set(chunks.keys())
+        if want_to_read <= have:
+            return {i: chunks[i] for i in want_to_read}
+        blocksize = len(next(iter(chunks.values())))
+        decoded = {}
+        for i in range(self.get_chunk_count()):
+            if i in chunks:
+                decoded[i] = np.asarray(chunks[i], np.uint8)
+            else:
+                decoded[i] = np.zeros(blocksize, np.uint8)
+        self.decode_chunks(want_to_read, chunks, decoded)
+        return {i: decoded[i] for i in want_to_read}
+
+    def decode_chunks(self, want_to_read: Set[int],
+                      chunks: Dict[int, np.ndarray],
+                      decoded: Dict[int, np.ndarray]) -> None:
+        raise NotImplementedError
+
+    def get_chunk_mapping(self) -> List[int]:
+        return self.chunk_mapping
+
+    def decode_concat(self, chunks: Dict[int, np.ndarray]) -> bytes:
+        """Recover and concatenate the data chunks in mapping order
+        (ErasureCode.cc:281-304 / ErasureCodeInterface.h:460)."""
+        k = self.get_data_chunk_count()
+        want = [self.chunk_index(i) for i in range(k)]
+        decoded = self.decode(set(want), chunks)
+        return b"".join(np.asarray(decoded[i], np.uint8).tobytes()
+                        for i in want)
+
+    # -- profile field parsing (to_int/to_bool, ErasureCode.cc:288-346)
+    @staticmethod
+    def to_int(name: str, profile: ErasureCodeProfile,
+               default: int) -> int:
+        v = profile.get(name, "")
+        if v == "":
+            profile[name] = str(default)
+            return default
+        try:
+            return int(v)
+        except ValueError:
+            raise ErasureCodeError(
+                -22, f"could not convert {name}={v} to int")
+
+    @staticmethod
+    def to_bool(name: str, profile: ErasureCodeProfile,
+                default: bool) -> bool:
+        v = profile.get(name, "")
+        if v == "":
+            return default
+        return v.lower() in ("yes", "true", "1", "on")
