@@ -8,14 +8,25 @@ rate was measured in-container from the reference's own C core:
 85099.6 mappings/s (BASELINE_MEASURED.json).  vs_baseline is the
 speedup over that number; the BASELINE.json target is 50x.
 
-Platform handling: the default backend (the TPU under the driver) is
-probed in a *subprocess with a timeout* so a hung/unavailable chip can
-never hang the bench; unavailability is retried with backoff (busy
-chip), then falls back to the CPU backend so a number is always
-produced.  The JSON line records which platform actually ran.
+Architecture (the "a number ALWAYS lands" contract):
 
-Secondary metrics (EC encode/decode GB/s) go to stderr so stdout stays
-one line.
+- The parent process never initializes any JAX backend.  Every bench
+  phase runs in a *subprocess* with a hard deadline and is killed on
+  expiry; a hung experimental TPU backend can cost its deadline,
+  nothing more.
+- The CPU measurement and the TPU attempt launch *concurrently*; the
+  headline JSON (TPU if it landed, else the CPU figure — with the CPU
+  figure recorded either way) prints immediately after the CRUSH phase,
+  before any EC work, so later phases can never lose it.
+- Workers enable JAX's persistent compilation cache under
+  ``.jax_cache/`` so the driver's next invocation hits warm XLA
+  artifacts; compile and measure wall times are reported separately.
+- Secondary metrics (EC encode/decode GB/s) follow on stderr.
+
+Deadlines (seconds, env-overridable):
+  CEPH_TPU_BENCH_TPU_DEADLINE   (default 300)
+  CEPH_TPU_BENCH_CPU_DEADLINE   (default 270)
+  CEPH_TPU_BENCH_EC_DEADLINE    (default 150)
 """
 
 import json
@@ -25,50 +36,42 @@ import subprocess
 import sys
 import time
 
-import numpy as np
-
 REPO = pathlib.Path(__file__).resolve().parent
 
 CPU_BASELINE_MAPPINGS_PER_SEC = json.load(
     open(REPO / "BASELINE_MEASURED.json"))["crush_mappings_per_sec_cpu"]
 
-PROBE_SRC = (
-    "import jax; d = jax.devices()[0]; print('PLATFORM=' + d.platform)"
-)
+TPU_DEADLINE = float(os.environ.get("CEPH_TPU_BENCH_TPU_DEADLINE", 300))
+CPU_DEADLINE = float(os.environ.get("CEPH_TPU_BENCH_CPU_DEADLINE", 270))
+EC_DEADLINE = float(os.environ.get("CEPH_TPU_BENCH_EC_DEADLINE", 150))
+
+RESULT_TAG = "BENCH_RESULT "
 
 
-def probe_default_backend(timeout=150, attempts=3, backoff=20):
-    """Initialize the default jax backend in a subprocess with a hard
-    timeout.  Returns the platform name or None if unusable.  Bounded
-    worst case (~8.5 min) so the guaranteed-fallback JSON line always
-    lands within a driver budget."""
-    env = dict(os.environ)
-    for i in range(attempts):
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", PROBE_SRC], env=env,
-                capture_output=True, text=True, timeout=timeout)
-        except subprocess.TimeoutExpired:
-            print(f"# backend probe attempt {i + 1}: timeout after "
-                  f"{timeout}s", file=sys.stderr)
-            out = None
-        if out is not None:
-            for line in out.stdout.splitlines():
-                if line.startswith("PLATFORM="):
-                    return line.split("=", 1)[1]
-            tail = (out.stderr or "").strip().splitlines()
-            print(f"# backend probe attempt {i + 1}: rc={out.returncode} "
-                  f"{tail[-1] if tail else ''}", file=sys.stderr)
-        if i + 1 < attempts:  # no dead sleep after the final attempt
-            time.sleep(backoff * (i + 1))
-    return None
+# ---------------------------------------------------------------------------
+# worker side (runs inside a subprocess; the only code that imports jax)
+# ---------------------------------------------------------------------------
+
+def _enable_compile_cache():
+    import jax
+
+    cache = str(REPO / ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as e:  # cache is an optimization, never a failure
+        print(f"# compile cache unavailable: {e}", file=sys.stderr)
 
 
-def bench_crush(batch=None, iters=None):
+def worker_crush(batch=None, iters=None):
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
-    on_accel = jax.devices()[0].platform != "cpu"
+    _enable_compile_cache()
+    plat = jax.devices()[0].platform
+    on_accel = plat != "cpu"
     if batch is None:
         batch = (1 << 17) if on_accel else (1 << 13)
     if iters is None:
@@ -80,52 +83,61 @@ def bench_crush(batch=None, iters=None):
     d = json.load(open(REPO / "tests/golden/map_big10k.json"))
     cmap = CrushMap.from_dict(d["map"])
     case = d["cases"][0]
-    fn, static, arrays = build_rule_fn(cmap, case["ruleno"],
-                                       case["numrep"])
+    t0 = time.perf_counter()
+    fn, static, arrays = build_rule_fn(cmap, case["ruleno"], case["numrep"])
     A = jax.tree_util.tree_map(jnp.asarray, arrays)
     weight = jnp.asarray(np.asarray(case["weight"], np.uint32))
-
     xs = jnp.arange(batch, dtype=jnp.uint32)
-    res, lens = fn(A, weight, xs)  # compile + warm
+    res, lens = fn(A, weight, xs)  # trace + compile + first run
     res.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    # golden cross-check on EVERY platform — the headline number must be
+    # a validated computation.  The golden xs [x0, x0+n) are a prefix of
+    # the warmup batch (x0 == 0), so this costs zero extra compiles.
+    n = min(256, case["x1"] - case["x0"], batch)
+    assert case["x0"] == 0, "golden case must start at x=0"
+    gres = np.asarray(res[:n])
+    glens = np.asarray(lens[:n])
+    for i in range(n):
+        want = case["results"][i]
+        got = list(gres[i, :glens[i]])
+        assert got == want, f"golden mismatch at x={i} on {plat}"
 
     t0 = time.perf_counter()
     for i in range(iters):
         xs_i = jnp.arange(i * batch, (i + 1) * batch, dtype=jnp.uint32)
         res, lens = fn(A, weight, xs_i)
     res.block_until_ready()
-    dt = time.perf_counter() - t0
-    rate = batch * iters / dt
+    measure_s = time.perf_counter() - t0
+    rate = batch * iters / measure_s
 
-    # cross-check a slice against the golden vectors
-    n = min(256, case["x1"] - case["x0"])
-    gres, glens = fn(A, weight,
-                     jnp.arange(case["x0"], case["x0"] + n,
-                                dtype=jnp.uint32))
-    gres = np.asarray(gres)
-    glens = np.asarray(glens)
-    for i in range(n):
-        want = case["results"][i]
-        got = list(gres[i, :glens[i]])
-        assert got == want, f"golden mismatch at x={case['x0'] + i}"
-    return rate
+    print(RESULT_TAG + json.dumps({
+        "rate": rate, "platform": plat,
+        "compile_s": round(compile_s, 2),
+        "measure_s": round(measure_s, 3),
+        "batch": batch, "iters": iters,
+    }), flush=True)
 
 
-def bench_ec(k=8, m=3, chunk=None, batch=4, iters=8):
+def worker_ec(k=8, m=3, chunk=None, batch=4, iters=8):
     import jax
     import jax.numpy as jnp
+    import numpy as np
 
+    _enable_compile_cache()
+    plat = jax.devices()[0].platform
     from ceph_tpu.ec.rs_jax import RSCode
 
     if chunk is None:
-        chunk = (1 << 20) if jax.devices()[0].platform != "cpu" \
-            else (1 << 16)
+        chunk = (1 << 20) if plat != "cpu" else (1 << 16)
     code = RSCode(k, m)
     rng = np.random.default_rng(0)
     data = jnp.asarray(rng.integers(0, 256, (k, batch * chunk),
                                     dtype=np.uint8))
+    t0 = time.perf_counter()
     out = code.encode(data)
     out.block_until_ready()
+    compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     for _ in range(iters):
         out = code.encode(data)
@@ -146,41 +158,130 @@ def bench_ec(k=8, m=3, chunk=None, batch=4, iters=8):
     out.block_until_ready()
     dt = time.perf_counter() - t0
     dec_gbps = (k * batch * chunk * iters) / dt / 1e9
-    return enc_gbps, dec_gbps
+    print(RESULT_TAG + json.dumps({
+        "encode_gbps": round(enc_gbps, 3),
+        "decode_gbps": round(dec_gbps, 3),
+        "platform": plat, "compile_s": round(compile_s, 2),
+    }), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# parent side (orchestration; no jax import)
+# ---------------------------------------------------------------------------
+
+def _spawn(phase: str, platform: str):
+    """Start a worker subprocess; platform 'cpu' pins the CPU backend
+    through BOTH channels (env var and CEPH_TPU_PLATFORM → jax.config),
+    since preloaded images can make the env var alone a no-op."""
+    env = dict(os.environ)
+    if platform == "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        env["CEPH_TPU_PLATFORM"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, str(REPO / "bench.py"), "--worker", phase],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=str(REPO))
+
+
+def _collect(proc, deadline: float, label: str):
+    """Wait for a worker up to its deadline; returns parsed result or
+    None.  Kills the process tree on expiry — a hung backend cannot
+    outlive its budget."""
+    if proc is None:
+        return None
+    try:
+        out, err = proc.communicate(timeout=deadline)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        try:
+            out, err = proc.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            out, err = "", ""
+        print(f"# {label}: killed after {deadline:.0f}s deadline",
+              file=sys.stderr)
+        return None
+    for line in (out or "").splitlines():
+        if line.startswith(RESULT_TAG):
+            return json.loads(line[len(RESULT_TAG):])
+    tail = (err or "").strip().splitlines()
+    print(f"# {label}: rc={proc.returncode} "
+          f"{tail[-1] if tail else '(no output)'}", file=sys.stderr)
+    return None
 
 
 def main():
-    from ceph_tpu.utils.platform import apply_platform_env
+    force_cpu = os.environ.get("CEPH_TPU_PLATFORM", "") == "cpu"
 
-    apply_platform_env()  # CEPH_TPU_PLATFORM=cpu forces the CPU backend
+    # CRUSH phase: CPU measurement and TPU attempt race concurrently.
+    t_start = time.perf_counter()
+    cpu_proc = _spawn("crush", "cpu")
+    tpu_proc = None if force_cpu else _spawn("crush", "default")
 
-    if not os.environ.get("CEPH_TPU_PLATFORM"):
-        plat = probe_default_backend()
-        if plat is None:
-            print("# default backend unusable; falling back to cpu",
+    cpu_res = _collect(cpu_proc, CPU_DEADLINE, "crush/cpu")
+    elapsed = time.perf_counter() - t_start
+    tpu_res = _collect(tpu_proc, max(10.0, TPU_DEADLINE - elapsed),
+                       "crush/default")
+    if tpu_res is not None and tpu_res.get("platform") == "cpu":
+        # default backend resolved to cpu (no accelerator attached);
+        # the two identical CPU runs contended for cores, so keep the
+        # higher (less-depressed) rate as the CPU figure
+        if cpu_res is None or tpu_res["rate"] > cpu_res["rate"]:
+            cpu_res = tpu_res
+        tpu_res = None
+
+    headline = tpu_res or cpu_res
+    if headline is None:
+        # last resort: tiny in-process CPU run so the line still lands
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["CEPH_TPU_PLATFORM"] = "cpu"
+        print("# both crush workers failed; in-process cpu fallback",
+              file=sys.stderr)
+        import io
+        import contextlib
+        buf = io.StringIO()
+        try:
+            with contextlib.redirect_stdout(buf):
+                worker_crush(batch=1 << 10, iters=1)
+        except Exception as e:
+            print(f"# in-process fallback failed too: {e}",
                   file=sys.stderr)
-            import jax
+        for line in buf.getvalue().splitlines():
+            if line.startswith(RESULT_TAG):
+                headline = json.loads(line[len(RESULT_TAG):])
+    if headline is None:
+        # absolute sentinel: the contract is one JSON line, always
+        headline = {"rate": 0.0, "platform": "none"}
 
-            jax.config.update("jax_platforms", "cpu")
-
-    import jax
-
-    dev = jax.devices()[0].platform
-    rate = bench_crush()
-    try:
-        enc_gbps, dec_gbps = bench_ec()
-        print(f"# ec k=8,m=3: encode {enc_gbps:.2f} GB/s, "
-              f"decode {dec_gbps:.2f} GB/s on {dev}", file=sys.stderr)
-    except Exception as e:  # EC is secondary; never break the one line
-        print(f"# ec bench failed: {e}", file=sys.stderr)
-    print(json.dumps({
+    rate = headline["rate"]
+    out = {
         "metric": "crush_mappings_per_sec",
         "value": round(rate, 1),
         "unit": "mappings/s",
-        "platform": dev,
+        "platform": headline["platform"],
         "vs_baseline": round(rate / CPU_BASELINE_MAPPINGS_PER_SEC, 2),
-    }))
+        "compile_s": headline.get("compile_s"),
+        "measure_s": headline.get("measure_s"),
+        "cpu_rate": round(cpu_res["rate"], 1) if cpu_res else None,
+    }
+    print(json.dumps(out), flush=True)  # the ONE line — lands first
+
+    # EC phase (secondary; stderr only, can never cost the headline)
+    ec_proc = None if force_cpu else _spawn("ec", "default")
+    ec_res = _collect(ec_proc, EC_DEADLINE, "ec/default")
+    if ec_res is None:
+        ec_res = _collect(_spawn("ec", "cpu"), EC_DEADLINE, "ec/cpu")
+    if ec_res is not None:
+        print(f"# ec k=8,m=3: encode {ec_res['encode_gbps']:.2f} GB/s, "
+              f"decode {ec_res['decode_gbps']:.2f} GB/s on "
+              f"{ec_res['platform']} (compile {ec_res['compile_s']}s)",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        from ceph_tpu.utils.platform import apply_platform_env
+
+        apply_platform_env()
+        {"crush": worker_crush, "ec": worker_ec}[sys.argv[2]]()
+    else:
+        main()

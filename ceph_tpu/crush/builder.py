@@ -73,6 +73,115 @@ def make_tree_bucket(items: Sequence[int], weights: Sequence[int],
                   node_weights=node_weights, weight=sum(weights))
 
 
+def calc_straw(weights: Sequence[int]) -> List[int]:
+    """crush_calc_straw (builder.c), straw_calc_version=1 semantics:
+    straw lengths (16.16) such that expected win probability is
+    proportional to weight.  Kept for legacy straw buckets; straw2
+    needs no precomputation."""
+    size = len(weights)
+    reverse = sorted(range(size), key=lambda i: (weights[i], i))
+    straws = [0] * size
+    straw = 1.0
+    wbelow = 0.0
+    lastw = 0.0
+    numleft = size
+    i = 0
+    while i < size:
+        if weights[reverse[i]] == 0:
+            straws[reverse[i]] = 0
+            i += 1
+            numleft -= 1
+            continue
+        straws[reverse[i]] = int(straw * 0x10000)
+        i += 1
+        if i == size:
+            break
+        wbelow += (float(weights[reverse[i - 1]]) - lastw) * numleft
+        numleft -= 1
+        wnext = numleft * (weights[reverse[i]] - weights[reverse[i - 1]])
+        pbelow = wbelow / (wbelow + wnext)
+        straw *= (1.0 / pbelow) ** (1.0 / numleft)
+        lastw = float(weights[reverse[i - 1]])
+    return straws
+
+
+def _rebuild_payload(b: Bucket) -> None:
+    """Recompute the per-alg payload from items/item_weights — the role
+    of builder.c's per-alg adjust/add/remove helpers (builder.h:163-283),
+    done by reconstruction (equivalent result, simpler invariant)."""
+    if b.alg == C.CRUSH_BUCKET_UNIFORM:
+        b.weight = b.item_weight * len(b.items)
+        return
+    if b.alg == C.CRUSH_BUCKET_LIST:
+        t = make_list_bucket(b.items, b.item_weights, b.type, b.id, b.hash)
+        b.sum_weights, b.weight = t.sum_weights, t.weight
+        return
+    if b.alg == C.CRUSH_BUCKET_TREE:
+        t = make_tree_bucket(b.items, b.item_weights, b.type, b.id, b.hash)
+        b.num_nodes, b.node_weights, b.weight = \
+            t.num_nodes, t.node_weights, t.weight
+        return
+    if b.alg == C.CRUSH_BUCKET_STRAW:
+        b.straws = calc_straw(b.item_weights)
+    b.weight = sum(b.item_weights)
+
+
+def bucket_add_item(b: Bucket, item: int, weight: int) -> None:
+    """crush_bucket_add_item (builder.h:214)."""
+    if b.alg == C.CRUSH_BUCKET_UNIFORM:
+        if b.items and weight != b.item_weight:
+            raise ValueError("uniform bucket requires equal item weights")
+        b.item_weight = weight
+        b.items.append(item)
+    else:
+        b.items.append(item)
+        b.item_weights.append(weight)
+    _rebuild_payload(b)
+
+
+def bucket_remove_item(b: Bucket, item: int) -> int:
+    """crush_bucket_remove_item (builder.h:232); returns the removed
+    weight."""
+    pos = b.items.index(item)
+    b.items.pop(pos)
+    if b.alg == C.CRUSH_BUCKET_UNIFORM:
+        removed = b.item_weight
+    else:
+        removed = b.item_weights.pop(pos)
+    _rebuild_payload(b)
+    return removed
+
+
+def bucket_adjust_item_weight(b: Bucket, item: int, weight: int) -> int:
+    """crush_bucket_adjust_item_weight (builder.h:223); returns the
+    weight delta."""
+    pos = b.items.index(item)
+    if b.alg == C.CRUSH_BUCKET_UNIFORM:
+        diff = (weight - b.item_weight) * len(b.items)
+        b.item_weight = weight
+    else:
+        diff = weight - b.item_weights[pos]
+        b.item_weights[pos] = weight
+    _rebuild_payload(b)
+    return diff
+
+
+def reweight_bucket(cmap: CrushMap, b: Bucket) -> None:
+    """crush_reweight_bucket (builder.h:242): recompute this bucket's
+    item weights from its children's (recursive, bottom-up)."""
+    for pos, item in enumerate(b.items):
+        if item < 0:
+            child = cmap.bucket_by_id(item)
+            if child is None:
+                continue
+            reweight_bucket(cmap, child)
+            if b.alg == C.CRUSH_BUCKET_UNIFORM:
+                b.item_weight = child.weight
+            else:
+                b.item_weights[pos] = child.weight
+    _rebuild_payload(b)
+
+
 def add_simple_rule(cmap: CrushMap, root_id: int, leaf_type: int,
                     firstn: bool = True, ruleno: int = -1,
                     rule_type: int = 1,

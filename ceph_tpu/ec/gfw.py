@@ -1,12 +1,14 @@
-"""GF(2^w) arithmetic for w in {8, 16, 32} + GF(2) bit-matrix algebra.
+"""GF(2^w) arithmetic for any w in 2..32 + GF(2) bit-matrix algebra.
 
 The reference's jerasure plugin supports word sizes w=8/16/32 for
 Reed-Solomon (src/erasure-code/jerasure/ErasureCodeJerasure.cc:191) and
-prime w for the bitmatrix codes; the GF kernels live in the vendored
-gf-complete/jerasure submodules which are ABSENT from the reference
-checkout (.gitmodules only).  This module re-derives the arithmetic from
-the published field definitions: the gf-complete default primitive
-polynomials 0x11D (w=8), 0x1100B (w=16), 0x400007 (w=32).
+any w <= 32 for the cauchy bitmatrix codes (:259-336); the GF kernels
+live in the vendored gf-complete/jerasure submodules which are ABSENT
+from the reference checkout (.gitmodules only).  This module re-derives
+the arithmetic from the published field definitions: the standard
+primitive-polynomial table used by jerasure's galois.c / gf-complete's
+gf_wgen (0x11D at w=8, 0x1100B at w=16, 0x400007 at w=32, etc.);
+primitivity of every table entry is asserted by the test suite.
 
 Also here: GF(2) bit-matrix utilities — inversion and the
 multiply-by-element expansion that turns any GF(2^w) linear code into a
@@ -21,12 +23,22 @@ from __future__ import annotations
 
 import numpy as np
 
-# gf-complete default primitive polynomials (low bits; implicit x^w term)
-GF_POLY = {8: 0x11D, 16: 0x1100B, 32: 0x400007}
+# Primitive polynomials, low bits only (implicit x^w term) — the
+# standard table from jerasure galois.c / gf-complete gf_wgen; w=8/16/32
+# match the gf-complete per-width defaults 0x11D / 0x1100B / 0x400007.
+GF_POLY = {
+    2: 0x3, 3: 0x3, 4: 0x3, 5: 0x5, 6: 0x3, 7: 0x09, 8: 0x1D,
+    9: 0x11, 10: 0x09, 11: 0x05, 12: 0x53, 13: 0x1B, 14: 0x443,
+    15: 0x03, 16: 0x100B, 17: 0x09, 18: 0x81, 19: 0x27, 20: 0x09,
+    21: 0x05, 22: 0x03, 23: 0x21, 24: 0x87, 25: 0x09, 26: 0x47,
+    27: 0x27, 28: 0x09, 29: 0x05, 30: 0x800007, 31: 0x09, 32: 0x400007,
+}
+
+_TABLE_MAX_W = 16  # log/exp tables up to 2^16; clmul above
 
 
 class GFW:
-    """One GF(2^w) field instance (w in {8, 16, 32})."""
+    """One GF(2^w) field instance (2 <= w <= 32)."""
 
     _cache: dict = {}
 
@@ -46,7 +58,7 @@ class GFW:
         self.poly = GF_POLY[w]
         self.size = 1 << w
         self.mask = self.size - 1
-        if w <= 16:
+        if w <= _TABLE_MAX_W:
             n = self.size - 1
             exp = np.zeros(2 * n, np.int64)
             log = np.zeros(self.size, np.int64)

@@ -1,0 +1,71 @@
+"""Generate ec_parity.json — pins this repo's own on-wire EC parity.
+
+The reference's vendored jerasure/gf-complete/isa-l submodules are
+absent, so the parity bytes cannot be diffed against the reference
+binaries; instead this pins OUR constructions (ceph_tpu.ec.matrices,
+documented divergences included) so refactors cannot silently change
+encoded data.  Regenerate only on a deliberate, documented format
+change:  python tests/golden/_gen_ec_parity.py
+"""
+
+import hashlib
+import json
+import pathlib
+import sys
+
+import numpy as np
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+sys.path.insert(0, str(REPO))
+
+import jax  # noqa: E402  (preloaded images pin a hardware backend;
+jax.config.update("jax_platforms", "cpu")  # golden gen is host-only)
+
+from ceph_tpu.ec.jerasure import make_jerasure  # noqa: E402
+
+CONFIGS = [
+    {"technique": "reed_sol_van", "k": "2", "m": "2", "w": "8"},
+    {"technique": "reed_sol_van", "k": "3", "m": "2", "w": "16"},
+    {"technique": "reed_sol_van", "k": "4", "m": "3", "w": "32"},
+    {"technique": "reed_sol_r6_op", "k": "4", "m": "2", "w": "8"},
+    {"technique": "cauchy_orig", "k": "2", "m": "2", "w": "4",
+     "packetsize": "8"},
+    {"technique": "cauchy_orig", "k": "4", "m": "3", "w": "8",
+     "packetsize": "8"},
+    {"technique": "cauchy_good", "k": "4", "m": "3", "w": "8",
+     "packetsize": "8"},
+    {"technique": "liberation", "k": "2", "m": "2", "w": "7",
+     "packetsize": "8"},
+    {"technique": "blaum_roth", "k": "2", "m": "2", "w": "6",
+     "packetsize": "8"},
+    {"technique": "liber8tion", "k": "2", "m": "2", "w": "8",
+     "packetsize": "8"},
+]
+
+OBJECT_SIZE = 1537  # deliberately unaligned to exercise padding
+
+
+def main():
+    rng = np.random.default_rng(0xEC)
+    raw = rng.integers(0, 256, OBJECT_SIZE, dtype=np.uint8).tobytes()
+    out = {"object_sha256": hashlib.sha256(raw).hexdigest(),
+           "object_size": OBJECT_SIZE, "seed": "0xEC", "cases": []}
+    for cfg in CONFIGS:
+        code = make_jerasure(dict(cfg))
+        n = code.get_chunk_count()
+        chunks = code.encode(range(n), raw)
+        out["cases"].append({
+            "profile": cfg,
+            "chunk_size": int(chunks[0].shape[0]),
+            "chunk_sha256": {
+                str(i): hashlib.sha256(
+                    np.asarray(chunks[i], np.uint8).tobytes()).hexdigest()
+                for i in sorted(chunks)},
+        })
+    path = pathlib.Path(__file__).parent / "ec_parity.json"
+    path.write_text(json.dumps(out, indent=1) + "\n")
+    print(f"wrote {path} ({len(out['cases'])} cases)")
+
+
+if __name__ == "__main__":
+    main()
