@@ -77,7 +77,13 @@ def calc_straw(weights: Sequence[int]) -> List[int]:
     """crush_calc_straw (builder.c), straw_calc_version=1 semantics:
     straw lengths (16.16) such that expected win probability is
     proportional to weight.  Kept for legacy straw buckets; straw2
-    needs no precomputation."""
+    needs no precomputation.
+
+    Note: v1 has NO equal-weight skip — that branch exists only in
+    straw_calc_version=0 (the historical buggy behavior); at equal
+    weights v1's wnext is 0, pbelow 1, and the straw carries unchanged,
+    which this port reproduces (pinned by test_calc_straw_v1_values).
+    """
     size = len(weights)
     reverse = sorted(range(size), key=lambda i: (weights[i], i))
     straws = [0] * size

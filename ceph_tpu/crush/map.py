@@ -237,6 +237,14 @@ class CrushMap:
             "rules": [{"ruleno": rno, **self.rules[rno].to_dict()}
                       for rno in sorted(self.rules)],
         }
+        if self.choose_args:
+            d["choose_args"] = {
+                str(key): [{"bucket_index": bi,
+                            "ids": ca.ids,
+                            "weight_set": ca.weight_set}
+                           for bi, ca in sorted(cam.items())]
+                for key, cam in self.choose_args.items()
+            }
         return d
 
     @classmethod
@@ -247,12 +255,22 @@ class CrushMap:
         for rd in d.get("rules", []):
             m.add_rule(Rule.from_dict(rd), rd.get("ruleno", -1))
         m.max_devices = max(m.max_devices, d.get("max_devices", 0))
-        if "choose_args" in d:
+        ca_in = d.get("choose_args")
+        if isinstance(ca_in, list):
+            # legacy golden-vector format: one anonymous set
             cam = ChooseArgMap()
-            for e in d["choose_args"]:
+            for e in ca_in:
                 cam[e["bucket_index"]] = ChooseArg(
                     ids=e.get("ids"), weight_set=e.get("weight_set"))
             m.choose_args["golden"] = cam
+        elif isinstance(ca_in, dict):
+            for key, entries in ca_in.items():
+                cam = ChooseArgMap()
+                for e in entries:
+                    cam[e["bucket_index"]] = ChooseArg(
+                        ids=e.get("ids"),
+                        weight_set=e.get("weight_set"))
+                m.choose_args[key] = cam
         return m
 
     def to_json(self) -> str:

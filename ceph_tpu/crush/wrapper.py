@@ -33,7 +33,10 @@ class CrushWrapper:
     def __init__(self, cmap: Optional[CrushMap] = None,
                  types: Optional[Dict[int, str]] = None):
         self.crush = cmap or CrushMap()
-        self.type_map: Dict[int, str] = dict(types or DEFAULT_TYPES)
+        # an explicitly-empty types dict is honored (the compiler
+        # starts from nothing); only None means "use the defaults"
+        self.type_map: Dict[int, str] = dict(
+            DEFAULT_TYPES if types is None else types)
         self.name_map: Dict[int, str] = {}        # item/bucket id -> name
         self.rule_name_map: Dict[int, str] = {}
         # device classes (CrushWrapper.h:1280-1340)
@@ -45,25 +48,58 @@ class CrushWrapper:
         # shadow ids survive rebuilds so class rules stay valid
         self._shadow_id_registry: Dict[Tuple[int, int], int] = {}
         self._shadow_dirty = False
+        # topology caches (parent index, subtree sets, name reverse
+        # map): the balancer's remap engine does these lookups per-OSD
+        # per-level on 10k-OSD maps, so they must be O(1), not scans.
+        # Keyed by (version, bucket count) — wrapper mutators bump the
+        # version; direct CrushMap bucket additions change the count;
+        # anything else must call invalidate_caches().
+        self._topo_version = 0
+        self._idx_key: Tuple = ()
+        self._parent_idx: Dict[int, int] = {}
+        self._name_idx: Dict[str, int] = {}
+        self._desc_cache: Dict[int, Set[int]] = {}
+        self._cot_cache: Dict[Tuple[int, int], List[int]] = {}
+
+    def invalidate_caches(self) -> None:
+        self._topo_version += 1
+
+    def _indexes(self) -> None:
+        key = (self._topo_version, len(self.crush.buckets),
+               len(self.name_map))
+        if self._idx_key != key:
+            parent: Dict[int, int] = {}
+            for b in self.crush.buckets.values():
+                if b.id in self._shadow_ids:
+                    continue
+                for it in b.items:
+                    parent[it] = b.id
+            self._parent_idx = parent
+            self._name_idx = {n: i for i, n in self.name_map.items()}
+            self._desc_cache = {}
+            self._cot_cache = {}
+            self._idx_key = key
 
     # -- name maps (CrushWrapper.h:490-630) ---------------------------
     def get_item_name(self, item: int) -> str:
         return self.name_map.get(item, f"item{item}")
 
     def get_item_id(self, name: str) -> int:
-        for i, n in self.name_map.items():
-            if n == name:
-                return i
-        raise KeyError(f"no item named {name!r}")
+        self._indexes()
+        if name not in self._name_idx:
+            raise KeyError(f"no item named {name!r}")
+        return self._name_idx[name]
 
     def name_exists(self, name: str) -> bool:
-        return name in self.name_map.values()
+        self._indexes()
+        return name in self._name_idx
 
     def set_item_name(self, item: int, name: str) -> None:
         if self.name_exists(name) and \
                 self.name_map.get(item) != name:
             raise ValueError(f"name {name!r} already in use")
         self.name_map[item] = name
+        self.invalidate_caches()  # renames keep len(name_map) constant
 
     def rename_item(self, old: str, new: str) -> None:
         self.set_item_name(self.get_item_id(old), new)
@@ -128,22 +164,28 @@ class CrushWrapper:
         return list(self.get_bucket(bid).items)
 
     def get_immediate_parent_id(self, item: int) -> Optional[int]:
-        for b in self.crush.buckets.values():
-            if b.id in self._shadow_ids:
-                continue
-            if item in b.items:
-                return b.id
-        return None
+        self._indexes()
+        return self._parent_idx.get(item)
+
+    def _descendants(self, root: int) -> Set[int]:
+        self._indexes()
+        got = self._desc_cache.get(root)
+        if got is None:
+            got = {root}
+            stack = [root]
+            while stack:
+                cur = stack.pop()
+                if cur < 0:
+                    for child in self.get_bucket(cur).items:
+                        got.add(child)
+                        stack.append(child)
+            self._desc_cache[root] = got
+        return got
 
     def subtree_contains(self, root: int, item: int) -> bool:
-        if root == item:
-            return True
         if root >= 0:
-            return False
-        for child in self.get_bucket(root).items:
-            if self.subtree_contains(child, item):
-                return True
-        return False
+            return root == item
+        return item in self._descendants(root)
 
     def get_leaves(self, root: int) -> List[int]:
         """All devices under ``root`` (subtree walk)."""
@@ -155,14 +197,20 @@ class CrushWrapper:
         return out
 
     def get_children_of_type(self, root: int, type_: int) -> List[int]:
-        if self.get_bucket_type(root) == type_:
-            return [root]
-        if root >= 0:
-            return []
-        out: List[int] = []
-        for child in self.get_bucket(root).items:
-            out.extend(self.get_children_of_type(child, type_))
-        return out
+        self._indexes()
+        key = (root, type_)
+        got = self._cot_cache.get(key)
+        if got is None:
+            if self.get_bucket_type(root) == type_:
+                got = [root]
+            elif root >= 0:
+                got = []
+            else:
+                got = []
+                for child in self.get_bucket(root).items:
+                    got.extend(self.get_children_of_type(child, type_))
+            self._cot_cache[key] = got
+        return got
 
     def find_takes_by_rule(self, ruleno: int) -> List[int]:
         roots = []
@@ -216,6 +264,7 @@ class CrushWrapper:
                         child_id not in self.get_bucket(bid).items:
                     bucket_add_item(self.get_bucket(bid), child_id,
                                     child_weight)
+                    self.invalidate_caches()  # new parent edge
                     self._propagate(bid, child_weight)
             else:
                 if not create:
@@ -225,6 +274,7 @@ class CrushWrapper:
                 self.set_item_name(bid, name)
                 if child_id is not None:
                     bucket_add_item(b, child_id, child_weight)
+                    self.invalidate_caches()
             if lowest is None:
                 lowest = bid
             child_id = bid
@@ -263,6 +313,7 @@ class CrushWrapper:
         self.set_item_name(item, name)
         self.crush.max_devices = max(self.crush.max_devices, item + 1)
         self._shadow_dirty = True
+        self.invalidate_caches()
 
     def remove_item(self, item: int) -> None:
         """CrushWrapper::remove_item (CrushWrapper.h:964≈)."""
@@ -274,6 +325,7 @@ class CrushWrapper:
         self.name_map.pop(item, None)
         self.class_map.pop(item, None)
         self._shadow_dirty = True
+        self.invalidate_caches()
 
     def move_bucket(self, bid: int, loc: Dict[str, str]) -> None:
         """CrushWrapper::move_bucket (CrushWrapper.h:817): detach the
@@ -291,6 +343,7 @@ class CrushWrapper:
         bucket_add_item(self.get_bucket(dest), bid, b.weight)
         self._propagate(dest, b.weight)
         self._shadow_dirty = True
+        self.invalidate_caches()
 
     def swap_bucket(self, a: int, b: int) -> None:
         """CrushWrapper::swap_bucket: exchange contents (items/weights)
@@ -311,6 +364,7 @@ class CrushWrapper:
             bucket_adjust_item_weight(self.get_bucket(pb_), b, bb.weight)
             self._propagate(pb_, -diff)
         self._shadow_dirty = True
+        self.invalidate_caches()
 
     def adjust_item_weight(self, item: int, weight: int) -> None:
         """CrushWrapper::adjust_item_weight(f) (CrushWrapper.h:964):
@@ -323,6 +377,7 @@ class CrushWrapper:
                 diff = bucket_adjust_item_weight(b, item, weight)
                 self._propagate(b.id, diff)
         self._shadow_dirty = True
+        self.invalidate_caches()
 
     def reweight(self) -> None:
         """crushtool --reweight: recompute every bucket's weight
@@ -336,6 +391,7 @@ class CrushWrapper:
             if self.get_immediate_parent_id(b.id) is None:
                 reweight_bucket(self.crush, b)
         self._shadow_dirty = True
+        self.invalidate_caches()
 
     # -- rules ---------------------------------------------------------
     def add_simple_rule(self, name: str, root_name: str,
@@ -454,6 +510,41 @@ class CrushWrapper:
         spec — batch callers go through mapper_jax/BatchedMapper."""
         self._refresh_shadow()
         return crush_do_rule(self.crush, ruleno, x, numrep, list(weight))
+
+    # -- serialization (the framework's native named-map format) -------
+    def to_dict(self) -> Dict:
+        """CrushWrapper::encode parity: the map plus its name/type/
+        class metadata (CrushWrapper.h:1550)."""
+        self._refresh_shadow()
+        return {
+            "map": self.crush.to_dict(),
+            "type_map": {str(k): v for k, v in self.type_map.items()},
+            "name_map": {str(k): v for k, v in self.name_map.items()},
+            "rule_name_map": {str(k): v
+                              for k, v in self.rule_name_map.items()},
+            "class_map": {str(k): v for k, v in self.class_map.items()},
+            "class_name": {str(k): v
+                           for k, v in self.class_name.items()},
+            "shadow_ids": sorted(self._shadow_ids),
+            "class_bucket": [[list(k), v]
+                             for k, v in sorted(
+                                 self.class_bucket.items())],
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "CrushWrapper":
+        w = cls(CrushMap.from_dict(d["map"]),
+                types={int(k): v for k, v in d["type_map"].items()})
+        w.name_map = {int(k): v for k, v in d["name_map"].items()}
+        w.rule_name_map = {int(k): v
+                           for k, v in d["rule_name_map"].items()}
+        w.class_map = {int(k): v for k, v in d["class_map"].items()}
+        w.class_name = {int(k): v for k, v in d["class_name"].items()}
+        w._shadow_ids = set(d.get("shadow_ids", []))
+        for key, sid in d.get("class_bucket", []):
+            w.class_bucket[tuple(key)] = sid
+            w._shadow_id_registry[tuple(key)] = sid
+        return w
 
     # -- upmap engine (CrushWrapper.cc:3841-4150) ----------------------
     def try_remap_rule(self, ruleno: int, maxout: int,

@@ -1,0 +1,17 @@
+"""Erasure coding — the reference's src/erasure-code/ surface on TPU.
+
+One execution engine (``engine.BitCode``: GF(2)-linear codes as mod-2
+MXU matmuls with a host decode-matrix cache) behind the reference's
+plugin boundary (``interface.ErasureCode`` /
+``registry`` — ErasureCodeInterface.h:170 / ErasureCodePlugin.h:45):
+
+- ``jerasure``: all seven techniques (reed_sol_van/r6, cauchy orig/
+  good, liberation, blaum_roth, liber8tion), any w in 2..32.
+- ``isa``: isa-l's Vandermonde/Cauchy generators, 32-byte alignment.
+- ``lrc``: layered locally-repairable codes, k/m/l or explicit layers.
+- ``shec``: shingled codes with the parity-subset recovery search.
+- ``clay``: coupled-layer MSR regenerating codes with sub-chunked
+  bandwidth-optimal single-node repair.
+- ``stripe``: the ECUtil stripe math + batched many-stripes data path.
+- ``rs_jax``: the array-level RS entry the bench/flagship use.
+"""

@@ -1,0 +1,120 @@
+"""The isa-equivalent plugin: RS over GF(2^8) with isa-l's generators.
+
+Mirrors src/erasure-code/isa/ErasureCodeIsa.{h,cc}: the same two
+techniques (``reed_sol_van`` = isa-l gf_gen_rs_matrix Vandermonde,
+``cauchy`` = gf_gen_cauchy1_matrix), the same defaults (k=7, m=3,
+ErasureCodeIsa.cc:46-47), the same Vandermonde MDS-safety clamps
+(:331-360) and 32-byte chunk alignment (xor_op.h:28, get_chunk_size
+:66-79).  Where isa-l runs table-driven SSE/AVX GF multiplies
+(ec_encode_data, :129) with an LRU decode-table cache (:227-304), this
+plugin expands the generator to a GF(2) bit matrix once and runs the
+MXU mod-2 matmul engine — the decode-matrix-per-erasure-signature cache
+lives in ``engine.BitCode`` (the IsaTableCache flow).  The m=1 /
+single-erasure region_xor fast paths (:125-127) need no special case:
+an all-ones generator row IS the XOR as a matmul.
+"""
+
+from __future__ import annotations
+
+from . import matrices as M
+from .engine import BitCode, Layout
+from .gfw import GFW
+from .interface import ErasureCode, ErasureCodeError, ErasureCodeProfile
+
+EC_ISA_ADDRESS_ALIGNMENT = 32  # xor_op.h:28
+
+DEFAULT_K = 7
+DEFAULT_M = 3
+
+
+class ErasureCodeIsa(ErasureCode):
+    """Both isa techniques; ``technique`` selects the generator."""
+
+    def __init__(self, technique: str = "reed_sol_van"):
+        super().__init__()
+        self.technique = technique
+        self.k = 0
+        self.m = 0
+        self._code: BitCode | None = None
+
+    def init(self, profile: ErasureCodeProfile) -> None:
+        profile["technique"] = self.technique
+        self.parse(profile)
+        self.prepare()
+        super().init(profile)
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        self.k = self.to_int("k", profile, DEFAULT_K)
+        self.m = self.to_int("m", profile, DEFAULT_M)
+        self.sanity_check_k_m(self.k, self.m)
+        if self.technique == "reed_sol_van":
+            # isa-l's Vandermonde construction is not MDS everywhere;
+            # clamp to the verified-safe region (ErasureCodeIsa.cc:331)
+            if self.k > 32:
+                raise ErasureCodeError(
+                    -22, f"Vandermonde: k={self.k} must be <= 32")
+            if self.m > 4:
+                raise ErasureCodeError(
+                    -22, f"Vandermonde: m={self.m} must be < 5 for MDS")
+            if self.m == 4 and self.k > 21:
+                raise ErasureCodeError(
+                    -22, f"Vandermonde: k={self.k} must be < 22 at m=4")
+
+    def prepare(self) -> None:
+        if self.technique == "cauchy":
+            full = M.isa_gf_gen_cauchy1_matrix(self.k, self.m)
+        else:
+            full = M.isa_gf_gen_rs_matrix(self.k, self.m)
+        coding = full[self.k:]
+        cb = GFW(8).expand_bitmatrix(coding)
+        self._code = BitCode(self.k, self.m, cb, Layout(8))
+
+    # -- geometry (ErasureCodeIsa.cc:66-79) ---------------------------
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def get_alignment(self) -> int:
+        return EC_ISA_ADDRESS_ALIGNMENT
+
+    def get_chunk_size(self, object_size: int) -> int:
+        alignment = self.get_alignment()
+        chunk_size = (object_size + self.k - 1) // self.k
+        modulo = chunk_size % alignment
+        if modulo:
+            chunk_size += alignment - modulo
+        return chunk_size
+
+    # -- data path (same engine as jerasure) --------------------------
+    def encode_chunks(self, want_to_encode, chunks) -> None:
+        import numpy as np
+
+        data = np.stack([np.asarray(chunks[self.chunk_index(i)],
+                                    np.uint8)
+                         for i in range(self.k)])
+        parity = np.asarray(self._code.encode(data))
+        for i in range(self.m):
+            chunks[self.chunk_index(self.k + i)] = parity[i]
+
+    def decode_chunks(self, want_to_read, chunks, decoded) -> None:
+        import numpy as np
+
+        erased = [i for i in range(self.k + self.m) if i not in chunks]
+        out = self._code.decode(erased,
+                                {i: np.asarray(c, np.uint8)
+                                 for i, c in chunks.items()})
+        for i, buf in out.items():
+            decoded[i] = np.asarray(buf)
+
+
+def make_isa(profile: ErasureCodeProfile) -> ErasureCodeIsa:
+    """Plugin factory (ErasureCodePluginIsa.cc:41-55 flow)."""
+    technique = profile.get("technique", "reed_sol_van")
+    if technique not in ("reed_sol_van", "cauchy"):
+        raise ErasureCodeError(
+            -2, f"technique={technique} must be reed_sol_van or cauchy")
+    inst = ErasureCodeIsa(technique)
+    inst.init(profile)
+    return inst

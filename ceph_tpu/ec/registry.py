@@ -1,0 +1,63 @@
+"""The erasure-code plugin registry.
+
+The role of ``ErasureCodePluginRegistry``
+(src/erasure-code/ErasureCodePlugin.h:45-80, ErasureCodePlugin.cc:128):
+one factory entry point keyed by plugin name, dispatching to the
+in-tree plugins.  Where the reference dlopens ``libec_<name>.so`` and
+checks version/entry points, the plugins here are Python modules; the
+``preload`` hook (the ``osd_erasure_code_plugins`` startup list) is a
+no-op kept for interface parity.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from .interface import ErasureCode, ErasureCodeError, ErasureCodeProfile
+
+_FACTORIES: Dict[str, Callable[[ErasureCodeProfile], ErasureCode]] = {}
+
+
+def register(name: str,
+             factory: Callable[[ErasureCodeProfile], ErasureCode]) -> None:
+    _FACTORIES[name] = factory
+
+
+def plugins() -> list:
+    return sorted(_FACTORIES)
+
+
+def factory(plugin: str, profile: ErasureCodeProfile) -> ErasureCode:
+    """ErasureCodePluginRegistry::factory: instantiate + init.
+
+    ``profile['plugin']`` is the reference's profile convention; the
+    explicit argument wins, as in the C++ signature."""
+    f = _FACTORIES.get(plugin)
+    if f is None:
+        raise ErasureCodeError(
+            -2, f"unknown erasure-code plugin {plugin!r}; "
+                f"have {plugins()}")
+    return f(dict(profile))
+
+
+def profile_factory(profile: ErasureCodeProfile) -> ErasureCode:
+    """Build from a profile dict alone (plugin= key, default jerasure —
+    the OSDMonitor default profile behavior)."""
+    return factory(profile.get("plugin", "jerasure"), profile)
+
+
+def _register_builtins() -> None:
+    from .jerasure import make_jerasure
+    from .isa import make_isa
+    from .lrc import make_lrc
+    from .shec import make_shec
+    from .clay import make_clay
+
+    register("jerasure", make_jerasure)
+    register("isa", make_isa)
+    register("lrc", make_lrc)
+    register("shec", make_shec)
+    register("clay", make_clay)
+
+
+_register_builtins()

@@ -1,82 +1,35 @@
-"""Reed-Solomon encode/decode as bit-plane matmuls — the TPU data path.
+"""Reed-Solomon encode/decode — thin adapter over the one GF engine.
 
-The reference's hot EC loop is ``ec_encode_data`` (isa-l asm,
-ErasureCodeIsa.cc:129) / jerasure's XOR schedules: per-byte table lookups
-vectorized with SSE/AVX shuffles.  TPUs have no byte-shuffle unit but they
-have the MXU, and GF(2^8) multiplication by a constant is linear over
-GF(2).  So instead of translating table lookups, the whole (k+m, k) code
-is expanded once into an (8m, 8k) 0/1 bit matrix (gf.expand_bitmatrix)
-and applied as an integer matmul mod 2:
-
-    data u8[k, L]  → bit planes u8[8k, L]   (unpack, XLA elementwise)
-    parity planes  = (BM_i8 @ planes_i8) & 1     (MXU int8 matmul)
-    parity u8[m, L] ← pack bit planes
-
-Per-element products are 0/1, so the i32 accumulator
-(preferred_element_type=int32) holds at most the contraction depth
-8k <= 2048 << 2^31 — exact.  Decode is the same matmul with a host-inverted matrix
-(gf.decode_matrix), mirroring the reference's decode-table flow
-(ErasureCodeIsa.cc:227-304) including the LRU cache keyed by erasure
-signature (ErasureCodeIsaTableCache.cc).
+The flagship/bench entry point for RS(k, m) at w=8.  The execution
+lives in ``ceph_tpu.ec.engine`` (bit-plane MXU matmuls with the
+decode-matrix cache keyed by erasure signature — the reference's
+ErasureCodeIsaTableCache flow, ErasureCodeIsa.cc:227-304); this module
+only picks a generator matrix and exposes the array-level API the
+bench, flagship step, and stripe layer share.  One engine, every
+consumer: the interface plugins (jerasure/isa/lrc) ride the same
+``BitCode``.
 """
 
 from __future__ import annotations
 
-import functools
-from typing import Sequence
-
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 
 from . import gf
-
-_BITS = np.arange(8, dtype=np.uint8)
-
-
-def _unpack_bits(data):
-    """u8[r, L] -> u8[8r, L] bit planes, plane order: row-major (row, bit),
-    bit 0 (LSB) first to match gf.gf_const_bitmatrix."""
-    r, L = data.shape
-    planes = (data[:, None, :] >> _BITS[None, :, None]) & jnp.uint8(1)
-    return planes.reshape(8 * r, L)
+from .engine import (BitCode, Layout, _mod2_matmul, _pack_bytes,
+                     _unpack_bytes)
 
 
-def _pack_bits(planes):
-    """u8[8r, L] -> u8[r, L]."""
-    r8, L = planes.shape
-    p = planes.reshape(r8 // 8, 8, L)
-    return jnp.sum(p << _BITS[None, :, None], axis=1,
-                   dtype=jnp.uint8)
-
-
-@functools.partial(jax.jit, static_argnames=())
-def _bit_matmul(bm, planes):
-    """(R8, C8) 0/1 int8 @ (C8, L) 0/1 -> mod-2 (R8, L) uint8."""
-    acc = jax.lax.dot_general(
-        bm.astype(jnp.int8), planes.astype(jnp.int8),
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32)
-    return (acc & 1).astype(jnp.uint8)
-
-
-@jax.jit
 def gf_matmul_bits(bm, data):
-    """Apply an expanded bit matrix to byte data: u8[rows_out, L]."""
-    planes = _unpack_bits(data)
-    out_planes = _bit_matmul(bm, planes)
-    return _pack_bits(out_planes)
+    """Apply an expanded GF(2) bit matrix to byte data:
+    (8r, 8c) 0/1 @ u8[c, L] -> u8[r, L]."""
+    return _pack_bytes(_mod2_matmul(jnp.asarray(bm),
+                                    _unpack_bytes(jnp.asarray(data))))
 
 
 class RSCode:
-    """One compiled (k, m, technique) code instance.
-
-    Owns the generator matrix, its bit expansion on device, and an LRU of
-    inverted decode matrices keyed by the erasure signature — the same
-    shape as the reference's EC table cache (ErasureCodeIsaTableCache.h),
-    with XLA compilation replacing table generation.
-    """
+    """One compiled (k, m, technique) code instance on the engine."""
 
     def __init__(self, k: int, m: int, technique: str = "reed_sol_van"):
         self.k = k
@@ -88,43 +41,27 @@ class RSCode:
             self.G = gf.rs_cauchy_matrix(k, m)
         else:
             raise ValueError(f"unknown technique {technique!r}")
-        self._enc_bm = jnp.asarray(gf.expand_bitmatrix(self.G[k:]))
-        self._dec_cache = {}
+        self._bit = BitCode(k, m, gf.expand_bitmatrix(self.G[k:]),
+                            Layout(8))
 
     # -- encode -------------------------------------------------------
     def encode(self, data):
         """u8[k, L] -> parity u8[m, L] (device array)."""
-        data = jnp.asarray(data)
-        assert data.shape[0] == self.k
-        return gf_matmul_bits(self._enc_bm, data)
+        return self._bit.encode(data)
 
     def encode_np(self, data):
         return np.asarray(self.encode(data))
 
     # -- decode -------------------------------------------------------
-    def _decode_bm(self, present: Sequence[int]):
-        key = tuple(present)
-        bm = self._dec_cache.get(key)
-        if bm is None:
-            inv = gf.decode_matrix(self.G, present, self.k)
-            bm = jnp.asarray(gf.expand_bitmatrix(inv))
-            self._dec_cache[key] = bm
-        return bm
-
     def decode(self, chunks, erasures):
         """chunks: dict chunk_index -> u8[L]; returns u8[k, L] data."""
-        present = sorted(i for i in chunks if i not in set(erasures))
-        present = present[:self.k]
-        if len(present) < self.k:
-            raise ValueError("need at least k chunks")
-        bm = self._decode_bm(present)
-        stack = jnp.stack([jnp.asarray(chunks[i]) for i in present])
-        return gf_matmul_bits(bm, stack)
+        avail = {i: c for i, c in chunks.items()
+                 if i not in set(erasures)}
+        return self._bit.decode_data(avail)
 
     def decode_np(self, chunks, erasures):
         return np.asarray(self.decode(chunks, erasures))
 
     def all_chunks(self, data):
         """u8[k, L] -> u8[k+m, L]: systematic data + parity."""
-        data = jnp.asarray(data)
-        return jnp.concatenate([data, self.encode(data)], axis=0)
+        return self._bit.all_chunks(data)

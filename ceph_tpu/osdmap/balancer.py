@@ -1,0 +1,387 @@
+"""The upmap balancer — calc_pg_upmaps on the batched mapper.
+
+Re-derives the reference's upmap optimizer
+(``OSDMap::calc_pg_upmaps``, src/osd/OSDMap.cc:4618-5115, plus
+``try_pg_upmap`` :4575 and ``CrushWrapper::get_rule_weight_osd_map``,
+src/crush/CrushWrapper.cc:2397): compute every OSD's PG-count deviation
+from its weight-proportional target, then iteratively move PGs from
+overfull to underfull OSDs by appending ``pg_upmap_items`` exception
+pairs, accepting only changes that strictly reduce the deviation
+stddev.
+
+TPU-first shape: the full-cluster "map every PG" pass that dominates
+the reference's runtime (OSDMap.cc:4642, via thread-pooled
+OSDMapMapping) is ONE batched launch per pool here
+(``PoolMapper.map_all``); the iterative search mutates host-side
+tallies exactly like the reference (no remapping inside the loop — the
+candidate evaluation is pure bookkeeping plus scalar
+``try_remap_rule`` calls).
+
+Divergence note: where the reference shuffles candidate lists with a
+``random_device`` in aggressive mode, this uses a seeded RNG so runs
+are reproducible; set ``seed`` for different explorations.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..crush.constants import CRUSH_ITEM_NONE
+from ..crush.wrapper import CrushWrapper
+from .osdmap import OSDMap, PgPool
+
+PgId = Tuple[int, int]  # (pool_id, ps)
+
+
+def get_rule_weight_osd_map(wrapper: CrushWrapper,
+                            ruleno: int) -> Dict[int, float]:
+    """osd -> normalized share of the rule's tree weight
+    (CrushWrapper.cc:2397): per TAKE, sum device weights under the
+    take root, normalize, merge."""
+    pmap: Dict[int, float] = {}
+    rule = wrapper.crush.rules.get(ruleno)
+    if rule is None:
+        raise KeyError(f"no rule {ruleno}")
+    for root in wrapper.find_takes_by_rule(ruleno):
+        m: Dict[int, float] = {}
+        total = 0.0
+        if root >= 0:
+            m[root] = 1.0
+            total = 1.0
+        else:
+            for leaf in wrapper.get_leaves(root):
+                p = wrapper.get_immediate_parent_id(leaf)
+                # weight of the leaf within its parent bucket
+                b = wrapper.get_bucket(p) if p is not None else None
+                w = (b.item_weight_at(b.items.index(leaf)) / 0x10000
+                     if b is not None else 0.0)
+                m[leaf] = m.get(leaf, 0.0) + w
+                total += w
+        if total:
+            for osd, w in m.items():
+                pmap[osd] = pmap.get(osd, 0.0) + w / total
+    return pmap
+
+
+def pg_to_raw_upmap(m: OSDMap, pool_id: int,
+                    ps: int) -> Tuple[List[int], List[int]]:
+    """OSDMap.cc:2635: (raw crush mapping, raw with upmaps applied)."""
+    pool = m.pools[pool_id]
+    raw, _pps = m._pg_to_raw_osds(pool_id, pool, ps)
+    pgid = (pool_id, pool.raw_pg_to_ps(ps))
+    upmapped = m._apply_upmap(pool, pgid, list(raw))
+    return raw, upmapped
+
+
+def try_pg_upmap(m: OSDMap, wrapper: CrushWrapper, pool_id: int,
+                 ps: int, overfull: Set[int], underfull: List[int],
+                 more_underfull: List[int]
+                 ) -> Optional[Tuple[List[int], List[int]]]:
+    """OSDMap.cc:4575: propose an alternative mapping for one PG via
+    CrushWrapper.try_remap_rule; None when nothing changes."""
+    pool = m.pools[pool_id]
+    if pool.crush_rule not in m.crush.rules:
+        return None
+    _raw, orig = pg_to_raw_upmap(m, pool_id, ps)
+    if not any(o in overfull for o in orig):
+        return None
+    out = wrapper.try_remap_rule(pool.crush_rule, pool.size, overfull,
+                                 underfull, more_underfull, orig)
+    if out == orig or len(out) != len(orig):
+        return None
+    return orig, out
+
+
+def build_pgs_by_osd(m: OSDMap,
+                     only_pools: Optional[Set[int]] = None,
+                     use_batched: bool = False
+                     ) -> Dict[int, Set[PgId]]:
+    """Map every PG of every (selected) pool and tally per OSD — the
+    full-cluster remap (OSDMap.cc:4633-4646).  ``use_batched`` routes
+    through the fused batched pipeline (one TPU launch per pool);
+    otherwise the scalar spec."""
+    pgs_by_osd: Dict[int, Set[PgId]] = {}
+    for pool_id, pool in m.pools.items():
+        if only_pools and pool_id not in only_pools:
+            continue
+        if use_batched:
+            import numpy as np
+
+            from .pipeline_jax import PoolMapper
+
+            out = PoolMapper(m, pool_id).map_all()
+            up = np.asarray(out["up"])
+            ulen = np.asarray(out["up_len"])
+            for ps in range(pool.pg_num):
+                pgid = (pool_id, ps)
+                for o in up[ps, :ulen[ps]]:
+                    if o != CRUSH_ITEM_NONE and o >= 0:
+                        pgs_by_osd.setdefault(int(o), set()).add(pgid)
+        else:
+            for ps in range(pool.pg_num):
+                up, _p, _a, _ap = m.pg_to_up_acting_osds(pool_id, ps)
+                for o in up:
+                    if o != CRUSH_ITEM_NONE:
+                        pgs_by_osd.setdefault(o, set()).add(
+                            (pool_id, ps))
+    return pgs_by_osd
+
+
+def _deviations(pgs_by_osd: Dict[int, Set[PgId]],
+                osd_weight: Dict[int, float], pgs_per_weight: float):
+    dev: Dict[int, float] = {}
+    stddev = 0.0
+    max_dev = 0.0
+    for osd, pgs in pgs_by_osd.items():
+        if osd not in osd_weight:
+            # an upmap-pair endpoint outside the weighted tree (e.g. a
+            # since-zeroed osd re-added by a drop-pair simulation); the
+            # reference ceph_asserts here — skipping is the safe
+            # equivalent (it has no target to deviate from)
+            continue
+        target = osd_weight[osd] * pgs_per_weight
+        d = len(pgs) - target
+        dev[osd] = d
+        stddev += d * d
+        max_dev = max(max_dev, abs(d))
+    return dev, stddev, max_dev
+
+
+def calc_pg_upmaps(m: OSDMap,
+                   max_deviation: int = 5,
+                   max_iterations: int = 10,
+                   only_pools: Optional[Set[int]] = None,
+                   wrapper: Optional[CrushWrapper] = None,
+                   use_batched: bool = False,
+                   aggressive: bool = True,
+                   local_fallback_retries: int = 100,
+                   seed: int = 0) -> int:
+    """OSDMap.cc:4618.  Mutates ``m.pg_upmap_items`` in place; returns
+    the number of table changes (additions + removals)."""
+    if max_deviation < 1:
+        max_deviation = 1
+    if wrapper is None:
+        wrapper = CrushWrapper(m.crush)
+    rng = random.Random(seed)
+
+    # -- the one full-cluster remap (the TPU launch) -------------------
+    pgs_by_osd = build_pgs_by_osd(m, only_pools, use_batched)
+
+    total_pgs = 0
+    osd_weight: Dict[int, float] = {}
+    osd_weight_total = 0.0
+    for pool_id, pool in m.pools.items():
+        if only_pools and pool_id not in only_pools:
+            continue
+        total_pgs += pool.size * pool.pg_num
+        pmap = get_rule_weight_osd_map(wrapper, pool.crush_rule)
+        for osd, share in pmap.items():
+            if osd >= len(m.osd_weight):
+                continue
+            adjusted = (m.osd_weight[osd] / 0x10000) * share
+            if adjusted == 0:
+                continue
+            osd_weight[osd] = osd_weight.get(osd, 0.0) + adjusted
+            osd_weight_total += adjusted
+    for osd in osd_weight:
+        pgs_by_osd.setdefault(osd, set())
+    # drop tallies for osds outside the weight map (down/out devices)
+    pgs_by_osd = {o: p for o, p in pgs_by_osd.items()
+                  if o in osd_weight}
+    if osd_weight_total == 0 or total_pgs == 0:
+        return 0
+    pgs_per_weight = total_pgs / osd_weight_total
+
+    osd_deviation, stddev, cur_max = _deviations(
+        pgs_by_osd, osd_weight, pgs_per_weight)
+    if cur_max <= max_deviation:
+        return 0
+
+    num_changed = 0
+    skip_overfull = False
+    it = max_iterations
+    while it > 0:
+        it -= 1
+        by_dev_desc = sorted(osd_deviation,
+                             key=lambda o: (-osd_deviation[o], o))
+        by_dev_asc = sorted(osd_deviation,
+                            key=lambda o: (osd_deviation[o], o))
+        overfull = {o for o in by_dev_desc
+                    if osd_deviation[o] > max_deviation}
+        more_overfull = {o for o in by_dev_desc
+                         if 0 < osd_deviation[o] <= max_deviation}
+        underfull = [o for o in by_dev_asc
+                     if osd_deviation[o] < -max_deviation]
+        more_underfull = [o for o in by_dev_asc
+                          if -max_deviation <= osd_deviation[o] < 0]
+        if not underfull and not overfull:
+            break
+        using_more_overfull = False
+        if not overfull and underfull:
+            overfull = more_overfull
+            using_more_overfull = True
+        if not overfull:
+            break
+
+        to_skip: Set[PgId] = set()
+        local_fallback_retried = 0
+        applied = False
+        while True:  # retry: label
+            to_unmap: Set[PgId] = set()
+            to_upmap: Dict[PgId, List[Tuple[int, int]]] = {}
+            temp = {o: set(p) for o, p in pgs_by_osd.items()}
+            found = _search_overfull(
+                m, wrapper, by_dev_desc, osd_deviation, osd_weight,
+                pgs_per_weight, overfull, underfull, more_underfull,
+                using_more_overfull, max_deviation, skip_overfull,
+                to_skip, temp, to_unmap, to_upmap, only_pools,
+                aggressive, rng)
+            if not found:
+                found = _search_underfull(
+                    m, by_dev_asc, osd_deviation, underfull,
+                    max_deviation, to_skip, temp, to_unmap, to_upmap,
+                    only_pools, aggressive, rng)
+            if not found:
+                if not aggressive:
+                    return num_changed
+                if not skip_overfull:
+                    return num_changed
+                skip_overfull = False
+                break  # continue outer loop
+            # test_change (OSDMap.cc:5031)
+            t_dev, new_stddev, cur_max = _deviations(
+                temp, osd_weight, pgs_per_weight)
+            if new_stddev >= stddev:
+                if not aggressive:
+                    return num_changed
+                local_fallback_retried += 1
+                if local_fallback_retried >= local_fallback_retries:
+                    skip_overfull = not skip_overfull
+                    break  # continue outer loop
+                to_skip |= to_unmap | set(to_upmap)
+                continue  # retry
+            # apply
+            stddev = new_stddev
+            pgs_by_osd = temp
+            osd_deviation = t_dev
+            for pgid in to_unmap:
+                del m.pg_upmap_items[pgid]
+                num_changed += 1
+            for pgid, items in to_upmap.items():
+                m.pg_upmap_items[pgid] = items
+                num_changed += 1
+            applied = True
+            break
+        if applied and cur_max <= max_deviation:
+            break
+    return num_changed
+
+
+def _search_overfull(m, wrapper, by_dev_desc, osd_deviation, osd_weight,
+                     pgs_per_weight, overfull, underfull,
+                     more_underfull, using_more_overfull, max_deviation,
+                     skip_overfull, to_skip, temp, to_unmap, to_upmap,
+                     only_pools, aggressive, rng) -> bool:
+    """OSDMap.cc:4771-4936: first change that helps an overfull osd."""
+    for osd in by_dev_desc:
+        if skip_overfull and underfull:
+            break
+        deviation = osd_deviation[osd]
+        if deviation < 0:
+            break
+        if not using_more_overfull and deviation <= max_deviation:
+            break
+        pgs = [p for p in sorted(temp.get(osd, ()))
+               if p not in to_skip]
+        if aggressive:
+            rng.shuffle(pgs)
+        # 1) drop an existing remapping pair that lands on this osd
+        for pgid in pgs:
+            items = m.pg_upmap_items.get(pgid)
+            if items is None:
+                continue
+            new_items = [q for q in items if q[1] != osd]
+            if len(new_items) == len(items):
+                continue
+            for q in items:
+                if q[1] == osd:
+                    temp[q[1]].discard(pgid)
+                    temp.setdefault(q[0], set()).add(pgid)
+            if not new_items:
+                to_unmap.add(pgid)
+            else:
+                to_upmap[pgid] = new_items
+            return True
+        # 2) append a new remapping pair
+        for pgid in pgs:
+            if pgid in m.pg_upmap:
+                continue  # balancer leaves explicit pg_upmap alone
+            pool_id, ps = pgid
+            pool = m.pools[pool_id]
+            existing: Set[int] = set()
+            new_items: List[Tuple[int, int]] = []
+            items = m.pg_upmap_items.get(pgid)
+            if items is not None:
+                if len(items) >= pool.size:
+                    continue
+                new_items = list(items)
+                for a, b in items:
+                    existing.add(a)
+                    existing.add(b)
+            res = try_pg_upmap(m, wrapper, pool_id, ps, overfull,
+                               underfull, more_underfull)
+            if res is None:
+                continue
+            orig, out = res
+            pos, max_dev = -1, 0.0
+            for i in range(len(out)):
+                if orig[i] == out[i]:
+                    continue
+                if orig[i] in existing or out[i] in existing:
+                    continue
+                d = osd_deviation.get(orig[i], 0.0)
+                if d > max_dev:
+                    max_dev, pos = d, i
+            if pos < 0:
+                continue
+            frm, to = orig[pos], out[pos]
+            temp.setdefault(frm, set()).discard(pgid)
+            temp.setdefault(to, set()).add(pgid)
+            new_items.append((frm, to))
+            to_upmap[pgid] = new_items
+            return True
+    return False
+
+
+def _search_underfull(m, by_dev_asc, osd_deviation, underfull,
+                      max_deviation, to_skip, temp, to_unmap, to_upmap,
+                      only_pools, aggressive, rng) -> bool:
+    """OSDMap.cc:4940-5010: cancel remapping pairs that drain an
+    underfull osd."""
+    for osd in by_dev_asc:
+        if osd not in underfull:
+            break
+        deviation = osd_deviation[osd]
+        if abs(deviation) < max_deviation:
+            break
+        candidates = [(pgid, items)
+                      for pgid, items in sorted(m.pg_upmap_items.items())
+                      if pgid not in to_skip
+                      and (not only_pools or pgid[0] in only_pools)]
+        if aggressive:
+            rng.shuffle(candidates)
+        for pgid, items in candidates:
+            new_items = [q for q in items if q[0] != osd]
+            if len(new_items) == len(items):
+                continue
+            for q in items:
+                if q[0] == osd:
+                    temp.setdefault(q[1], set()).discard(pgid)
+                    temp.setdefault(q[0], set()).add(pgid)
+            if not new_items:
+                to_unmap.add(pgid)
+            else:
+                to_upmap[pgid] = new_items
+            return True
+    return False

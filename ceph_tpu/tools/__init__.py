@@ -1,0 +1,12 @@
+"""CLI tools — the reference's src/tools/ surface, TPU-backed.
+
+- ``crushtool`` (compiler + tester): text crushmap compile/decompile,
+  --test sweeps on the batched mapper, --build, --compare, --tree.
+- ``osdmaptool``: --createsimple, --test-map-pgs over the fused
+  placement pipeline, --upmap (the balancer), --upmap-cleanup.
+- ``ec_benchmark``: per-plugin encode/decode throughput with
+  exhaustive-erasure sweeps.
+
+Each is an importable module (``main(argv)``) and a console entry
+(``python -m ceph_tpu.tools.<name>``).
+"""

@@ -1,0 +1,99 @@
+"""EC benchmark CLI — encode/decode throughput per plugin/profile.
+
+The role of src/test/erasure-code/ceph_erasure_code_benchmark.cc:40-330
+with the same knobs: --plugin, --workload encode|decode, --size,
+--iterations, --parameter k=v profile entries, --erasures N and
+--erasures-generation random|exhaustive (the decode sweep), --verify
+(decode output checked against the original, :225-236).  Output is the
+reference's two-column `elapsed \t KiB` line per run plus a summary
+GB/s figure.
+
+Usage: python -m ceph_tpu.tools.ec_benchmark --plugin jerasure \
+         -P k=4 -P m=2 --workload encode --size 16777216
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import sys
+import time
+
+import numpy as np
+
+from ..ec.registry import factory
+
+
+def exhaustive_erasures(n: int, count: int):
+    return itertools.combinations(range(n), count)
+
+
+def random_erasures(n: int, count: int, iterations: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    for _ in range(iterations):
+        yield tuple(sorted(rng.choice(n, count, replace=False)))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ec_benchmark")
+    p.add_argument("--plugin", default="jerasure")
+    p.add_argument("-P", "--parameter", action="append", default=[],
+                   help="profile key=value")
+    p.add_argument("--workload", choices=["encode", "decode"],
+                   default="encode")
+    p.add_argument("--size", type=int, default=1 << 20,
+                   help="total object bytes per iteration")
+    p.add_argument("--iterations", type=int, default=8)
+    p.add_argument("--erasures", type=int, default=1)
+    p.add_argument("--erasures-generation",
+                   choices=["random", "exhaustive"], default="random")
+    p.add_argument("--verify", action="store_true")
+    args = p.parse_args(argv)
+
+    profile = {}
+    for kv in args.parameter:
+        k, _, v = kv.partition("=")
+        profile[k] = v
+    code = factory(args.plugin, profile)
+    n = code.get_chunk_count()
+
+    rng = np.random.default_rng(1)
+    raw = rng.integers(0, 256, args.size, dtype=np.uint8).tobytes()
+    chunks = code.encode(range(n), raw)
+
+    total_bytes = 0
+    t0 = time.perf_counter()
+    if args.workload == "encode":
+        for _ in range(args.iterations):
+            code.encode(range(n), raw)
+            total_bytes += args.size
+    else:
+        if args.erasures_generation == "exhaustive":
+            gen = exhaustive_erasures(n, args.erasures)
+        else:
+            gen = random_erasures(n, args.erasures, args.iterations)
+        want = {code.chunk_index(i)
+                for i in range(code.get_data_chunk_count())}
+        for erased in gen:
+            avail = {i: c for i, c in chunks.items()
+                     if i not in erased}
+            out = code.decode(want, avail)
+            if args.verify:
+                got = b"".join(
+                    np.asarray(out[code.chunk_index(i)],
+                               np.uint8).tobytes()
+                    for i in range(code.get_data_chunk_count()))
+                assert got[:len(raw)] == raw, \
+                    f"verify failed for erasures {erased}"
+            total_bytes += args.size
+    elapsed = time.perf_counter() - t0
+
+    # the reference's output shape (benchmark.cc:184,315)
+    print(f"{elapsed:.6f}\t{total_bytes // 1024}")
+    print(f"# {args.plugin} {args.workload}: "
+          f"{total_bytes / elapsed / 1e9:.3f} GB/s", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

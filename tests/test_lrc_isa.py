@@ -1,0 +1,186 @@
+"""LRC + ISA plugin + registry tests.
+
+Mirrors src/test/erasure-code/TestErasureCodeLrc.cc (generated k/m/l
+profiles, explicit layers, minimum_to_decode locality) and
+TestErasureCodeIsa.cc (both techniques, round-trips, chunk size), plus
+plugin-registry dispatch (TestErasureCodePlugin.cc's factory flow).
+"""
+
+import itertools
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import registry
+from ceph_tpu.ec.interface import ErasureCodeError
+from ceph_tpu.ec.isa import make_isa
+from ceph_tpu.ec.lrc import make_lrc
+
+
+def _obj(n=3000, seed=7):
+    return np.random.default_rng(seed).integers(
+        0, 256, n, dtype=np.uint8).tobytes()
+
+
+# -- registry ---------------------------------------------------------------
+
+def test_registry_dispatch():
+    assert set(registry.plugins()) >= {"jerasure", "isa", "lrc"}
+    code = registry.factory("jerasure", {"technique": "reed_sol_van",
+                                         "k": "2", "m": "1"})
+    assert code.get_chunk_count() == 3
+    code = registry.profile_factory({"plugin": "isa", "k": "4",
+                                     "m": "2"})
+    assert code.get_chunk_count() == 6
+    with pytest.raises(ErasureCodeError):
+        registry.factory("nope", {})
+
+
+# -- isa --------------------------------------------------------------------
+
+@pytest.mark.parametrize("technique", ["reed_sol_van", "cauchy"])
+def test_isa_roundtrip(technique):
+    code = make_isa({"technique": technique, "k": "7", "m": "3"})
+    raw = _obj(5000)
+    chunks = code.encode(range(10), raw)
+    assert chunks[0].shape[0] % 32 == 0  # EC_ISA_ADDRESS_ALIGNMENT
+    for erased in itertools.combinations(range(10), 3):
+        avail = {i: c for i, c in chunks.items() if i not in erased}
+        assert code.decode_concat(avail)[:len(raw)] == raw
+
+
+def test_isa_m1_xor_path():
+    """m=1 degenerates to XOR parity (the region_xor fast path): the
+    parity chunk must equal the XOR of the data chunks."""
+    code = make_isa({"k": "4", "m": "1"})
+    raw = _obj(1000)
+    chunks = code.encode(range(5), raw)
+    want = np.zeros_like(np.asarray(chunks[0]))
+    for i in range(4):
+        want ^= np.asarray(chunks[i])
+    assert np.array_equal(np.asarray(chunks[4]), want)
+
+
+def test_isa_vandermonde_clamps():
+    with pytest.raises(ErasureCodeError):
+        make_isa({"k": "33", "m": "3"})
+    with pytest.raises(ErasureCodeError):
+        make_isa({"k": "7", "m": "5"})
+    with pytest.raises(ErasureCodeError):
+        make_isa({"k": "22", "m": "4"})
+    make_isa({"technique": "cauchy", "k": "33", "m": "5"})  # no clamp
+
+
+# -- lrc --------------------------------------------------------------------
+
+def test_lrc_kml_profile_generation():
+    code = make_lrc({"k": "4", "m": "2", "l": "3"})
+    prof = code.get_profile()
+    assert prof["mapping"] == "DD__DD__"
+    layers = json.loads(prof["layers"])
+    assert layers[0][0] == "DDc_DDc_"
+    assert layers[1][0] == "DDDc____"
+    assert layers[2][0] == "____DDDc"
+    assert code.get_chunk_count() == 8
+    assert code.get_data_chunk_count() == 4
+
+
+def test_lrc_kml_validation():
+    with pytest.raises(ErasureCodeError):
+        make_lrc({"k": "4", "m": "2"})  # l missing
+    with pytest.raises(ErasureCodeError):
+        make_lrc({"k": "4", "m": "2", "l": "5"})  # (k+m) % l != 0
+    with pytest.raises(ErasureCodeError):
+        make_lrc({"k": "4", "m": "2", "l": "3",
+                  "mapping": "DD__DD__"})  # generated key set
+    with pytest.raises(ErasureCodeError):
+        make_lrc({})  # no mapping at all
+
+
+def test_lrc_roundtrip_all_single_and_double_losses():
+    code = make_lrc({"k": "4", "m": "2", "l": "3"})
+    raw = _obj(4000)
+    n = code.get_chunk_count()
+    chunks = code.encode(range(n), raw)
+    for r in (1, 2):
+        for erased in itertools.combinations(range(n), r):
+            avail = {i: c for i, c in chunks.items()
+                     if i not in erased}
+            try:
+                got = code.decode_concat(avail)
+            except ErasureCodeError:
+                continue  # some double losses exceed LRC's capability
+            assert got[:len(raw)] == raw, f"erased={erased}"
+
+
+def test_lrc_local_repair_reads_fewer_than_k():
+    """BASELINE config 4: a single lost chunk repairs from its LOCAL
+    layer — strictly fewer chunks than the global k would need."""
+    code = make_lrc({"k": "4", "m": "2", "l": "3"})
+    n = code.get_chunk_count()
+    # lose data chunk 0 (in local group 0 = positions {0,1,2,3})
+    want = set(range(n))
+    minimum = code.minimum_to_decode({0}, want - {0})
+    assert set(minimum) <= {1, 2, 3}  # local group only
+    assert len(minimum) == 3  # l chunks, < global k=4 never mind equal
+    # and the repair actually works from exactly those chunks
+    raw = _obj(2000)
+    chunks = code.encode(range(n), raw)
+    avail = {i: chunks[i] for i in minimum}
+    out = code.decode({0}, avail)
+    assert np.array_equal(np.asarray(out[0]), np.asarray(chunks[0]))
+
+
+def test_lrc_explicit_layers():
+    code = make_lrc({
+        "mapping": "__DD__DD",
+        "layers": json.dumps([
+            ["_cDD_cDD", ""],
+            ["cDDD____", ""],
+            ["____cDDD", ""],
+        ]),
+    })
+    assert code.get_chunk_count() == 8
+    assert code.get_data_chunk_count() == 4
+    raw = _obj(1000)
+    chunks = code.encode(range(8), raw)
+    for erased in itertools.combinations(range(8), 1):
+        avail = {i: c for i, c in chunks.items() if i not in erased}
+        assert code.decode_concat(avail)[:len(raw)] == raw
+
+
+def test_lrc_minimum_no_erasure_is_want():
+    code = make_lrc({"k": "4", "m": "2", "l": "3"})
+    n = code.get_chunk_count()
+    got = code.minimum_to_decode({1, 2}, set(range(n)))
+    assert set(got) == {1, 2}
+
+
+def test_lrc_unrecoverable_raises():
+    code = make_lrc({"k": "4", "m": "2", "l": "3"})
+    with pytest.raises(ErasureCodeError):
+        # lose an entire local group plus its global parity
+        code.minimum_to_decode({0}, {4, 5, 6, 7})
+
+
+def test_lrc_create_rule_and_placement():
+    from ceph_tpu.crush.wrapper import CrushWrapper
+
+    w = CrushWrapper()
+    dev = 0
+    for h in range(8):
+        for _ in range(2):
+            w.insert_item(dev, 0x10000, f"osd.{dev}",
+                          {"host": f"host{h}", "root": "default"})
+            dev += 1
+    code = make_lrc({"k": "4", "m": "2", "l": "3",
+                     "crush-root": "default",
+                     "crush-failure-domain": "host"})
+    rid = code.create_rule("lrcpool", w)
+    n = code.get_chunk_count()
+    for x in range(16):
+        res = w.do_rule(rid, x, n, [0x10000] * 16)
+        assert len(res) == n
+        hosts = {o // 2 for o in res}
+        assert len(hosts) == n  # failure-domain separation

@@ -206,6 +206,43 @@ def test_reweight_recomputes_bottom_up():
     assert w.get_bucket(root).weight == 0x50000 + 3 * 0x10000
 
 
+def test_calc_straw_v1_values():
+    """Pin straw_calc_version=1 semantics: NO equal-weight skip (that
+    branch is v0-only); at equal weights wnext=0 so the straw carries
+    unchanged.  Hand-derived trace for [1, 1, 2] (16.16):
+    items 0,1 -> straw 1.0; item 2 -> 1.0 * (1/(3/4))^(1/1) = 4/3."""
+    from ceph_tpu.crush.builder import calc_straw
+
+    got = calc_straw([0x10000, 0x10000, 0x20000])
+    assert got[0] == got[1] == 0x10000
+    assert got[2] == int((4 / 3) * 0x10000)
+    # zero-weight items get zero straws (v1 branch)
+    assert calc_straw([0, 0x10000])[0] == 0
+
+
+def test_wrapper_serialization_roundtrip():
+    w = build_cluster(hosts=2)
+    for d in range(4):
+        w.set_item_class(d, "ssd" if d % 2 else "hdd")
+    w.add_simple_rule("r", "default", "host", "ssd", "firstn")
+    from ceph_tpu.crush.map import ChooseArg, ChooseArgMap
+    cam = ChooseArgMap()
+    cam[0] = ChooseArg(ids=None, weight_set=[[0x8000, 0x10000]])
+    w.crush.choose_args["p1"] = cam
+
+    w2 = CrushWrapper.from_dict(w.to_dict())
+    assert w2.get_item_id("default") == w.get_item_id("default")
+    assert w2.get_item_class(1) == "ssd"
+    assert w2.class_bucket == w.class_bucket
+    # choose_args survive (CrushWrapper::encode parity)
+    assert "p1" in w2.crush.choose_args
+    assert w2.crush.choose_args["p1"][0].weight_set == \
+        [[0x8000, 0x10000]]
+    weight = [0x10000] * 4
+    for x in range(32):
+        assert w.do_rule(0, x, 2, weight) == w2.do_rule(0, x, 2, weight)
+
+
 # -- try_remap_rule (the upmap engine) --------------------------------------
 
 def test_try_remap_rule_swaps_overfull():
