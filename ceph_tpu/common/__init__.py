@@ -1,0 +1,14 @@
+"""Foundation/runtime layer — the reference's src/common surface.
+
+- ``config``: option schema + layered sources + runtime observers
+  (md_config_t / ConfigProxy, src/common/config.h, options YAML).
+- ``log``: per-subsystem leveled logging with a crash-dump ring buffer
+  (src/log/Log.cc, SubsystemMap.h).
+- ``perf_counters``: u64/avg/histogram counters with a per-process
+  collection (src/common/perf_counters.h:63-141).
+- ``admin_socket``: unix-socket command/introspection plane
+  (src/common/admin_socket.h:105) serving perf dump / config show ...
+- ``throttle``: counting backpressure primitive
+  (src/common/Throttle.cc).
+- ``context``: CephContext analogue tying them together.
+"""

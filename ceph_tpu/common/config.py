@@ -1,0 +1,167 @@
+"""The config system — option schema, layered sources, observers.
+
+The role of the reference's ``md_config_t`` / ``ConfigProxy``
+(src/common/config.h) with options declared in YAML and compiled to
+``Option`` structs (src/common/options/*.yaml.in via options/y2c.py):
+here the schema is declared in Python (``Option`` dataclass +
+``OPTIONS`` table) — same information, no codegen step.
+
+Layering (lowest to highest precedence, config.h semantics):
+  compiled default < config file < environment < runtime ``set()``.
+
+Runtime changes notify registered observers (config_obs.h), which is
+how long-lived services pick up reweights/debug levels without
+restart.  ``show()`` is the ``ceph daemon ... config show`` payload.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+ENV_PREFIX = "CEPH_TPU_OPT_"
+
+
+@dataclass
+class Option:
+    """One declared option (src/common/options.h:14)."""
+
+    name: str
+    type_: type
+    default: Any
+    desc: str = ""
+    level: str = "advanced"  # basic | advanced | dev
+
+    def coerce(self, value: Any) -> Any:
+        if self.type_ is bool and isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return self.type_(value)
+
+
+def _opts(*options: Option) -> Dict[str, Option]:
+    return {o.name: o for o in options}
+
+
+# the framework's option schema — the global.yaml.in/osd.yaml.in role
+OPTIONS: Dict[str, Option] = _opts(
+    Option("debug_crush", int, 0, "crush subsystem log level"),
+    Option("debug_osd", int, 0, "osd-service subsystem log level"),
+    Option("debug_mon", int, 0, "monitor subsystem log level"),
+    Option("debug_ec", int, 0, "erasure-code subsystem log level"),
+    Option("log_max_recent", int, 500, "crash ring-buffer entries"),
+    Option("osd_pool_default_size", int, 3, "replica count default"),
+    Option("osd_pool_default_pg_num", int, 32, "pg count default"),
+    Option("osd_heartbeat_interval", float, 0.5,
+           "seconds between osd->mon heartbeats"),
+    Option("osd_heartbeat_grace", float, 2.0,
+           "seconds without heartbeat before mark-down"),
+    Option("mon_osd_down_out_interval", float, 5.0,
+           "seconds down before an osd is marked out (weight 0), "
+           "triggering remap + backfill"),
+    Option("osd_max_backfills", int, 1,
+           "concurrent recovery streams per osd"),
+    Option("osd_calc_pg_upmaps_aggressively", bool, True,
+           "balancer explores with shuffling and local fallbacks"),
+    Option("osd_calc_pg_upmaps_local_fallback_retries", int, 100,
+           "balancer local retry budget"),
+    Option("osd_erasure_code_plugins", str,
+           "jerasure isa lrc shec clay", "plugins loaded at start"),
+    Option("mon_max_map_epochs", int, 500,
+           "full OSDMap epochs retained by the map store"),
+    Option("bench_tpu_deadline", float, 300.0,
+           "seconds before the bench abandons a hung backend"),
+)
+
+
+class Config:
+    """Layered option store with observers."""
+
+    def __init__(self, schema: Optional[Dict[str, Option]] = None):
+        self.schema = dict(schema or OPTIONS)
+        self._file: Dict[str, Any] = {}
+        self._env: Dict[str, Any] = {}
+        self._override: Dict[str, Any] = {}
+        self._observers: Dict[str, List[Callable[[str, Any], None]]] = {}
+        self._load_env()
+
+    # -- sources ------------------------------------------------------
+    def _load_env(self) -> None:
+        for key, value in os.environ.items():
+            if key.startswith(ENV_PREFIX):
+                name = key[len(ENV_PREFIX):].lower()
+                if name in self.schema:
+                    self._env[name] = self.schema[name].coerce(value)
+
+    def load_file(self, path: str) -> int:
+        """Read a config file: JSON object or ini-ish `name = value`
+        lines (the ceph.conf role).  Returns options applied."""
+        with open(path) as f:
+            text = f.read()
+        applied = 0
+        stripped = text.lstrip()
+        entries: Dict[str, Any] = {}
+        if stripped.startswith("{"):
+            entries = json.loads(text)
+        else:
+            for line in text.splitlines():
+                line = line.split("#", 1)[0].split(";", 1)[0].strip()
+                if not line or line.startswith("["):
+                    continue
+                name, _, value = line.partition("=")
+                entries[name.strip().replace(" ", "_")] = value.strip()
+        for name, value in entries.items():
+            if name in self.schema:
+                self._file[name] = self.schema[name].coerce(value)
+                applied += 1
+        return applied
+
+    # -- access -------------------------------------------------------
+    def get(self, name: str) -> Any:
+        opt = self.schema.get(name)
+        if opt is None:
+            raise KeyError(f"unknown option {name!r}")
+        for layer in (self._override, self._env, self._file):
+            if name in layer:
+                return layer[name]
+        return opt.default
+
+    def __getitem__(self, name: str) -> Any:
+        return self.get(name)
+
+    def set(self, name: str, value: Any) -> None:
+        """Runtime override (`ceph config set` / injectargs role);
+        notifies observers."""
+        opt = self.schema.get(name)
+        if opt is None:
+            raise KeyError(f"unknown option {name!r}")
+        self._override[name] = opt.coerce(value)
+        for cb in self._observers.get(name, []):
+            cb(name, self._override[name])
+
+    def rm_override(self, name: str) -> None:
+        if self._override.pop(name, None) is not None:
+            for cb in self._observers.get(name, []):
+                cb(name, self.get(name))
+
+    def add_observer(self, name: str,
+                     cb: Callable[[str, Any], None]) -> None:
+        self._observers.setdefault(name, []).append(cb)
+
+    def source_of(self, name: str) -> str:
+        if name in self._override:
+            return "override"
+        if name in self._env:
+            return "env"
+        if name in self._file:
+            return "file"
+        return "default"
+
+    def show(self) -> Dict[str, Dict[str, Any]]:
+        """`config show`: every option with value + winning source."""
+        return {name: {"value": self.get(name),
+                       "source": self.source_of(name),
+                       "default": opt.default,
+                       "desc": opt.desc}
+                for name, opt in sorted(self.schema.items())}

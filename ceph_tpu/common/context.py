@@ -1,0 +1,59 @@
+"""CephContext analogue — one object tying the runtime together.
+
+The reference threads a ``CephContext*`` through every component
+(config proxy, log, perf counters collection, admin socket); services
+here take a ``Context`` the same way so tests can build isolated
+runtimes.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Optional
+
+from .admin_socket import AdminSocket, wire_defaults
+from .config import Config
+from .log import LogCore, SubsysLogger
+from .perf_counters import PerfCountersCollection
+
+
+class Context:
+    def __init__(self, name: str = "ceph-tpu",
+                 config: Optional[Config] = None,
+                 admin_dir: Optional[str] = None):
+        self.name = name
+        self.conf = config or Config()
+        self.log = LogCore(max_recent=self.conf["log_max_recent"])
+        self.perf = PerfCountersCollection()
+        self._admin: Optional[AdminSocket] = None
+        self._admin_dir = admin_dir
+
+    def logger(self, subsys: str) -> SubsysLogger:
+        lg = SubsysLogger(subsys, self.log)
+        # debug_<subsys> option drives the level, live (observer)
+        opt = f"debug_{subsys}"
+        if opt in self.conf.schema:
+            self.log.set_level(subsys, self.conf[opt])
+            self.conf.add_observer(
+                opt, lambda _n, v: self.log.set_level(subsys, int(v)))
+        return lg
+
+    @property
+    def admin_socket_path(self) -> str:
+        d = self._admin_dir or os.path.join(
+            tempfile.gettempdir(), "ceph_tpu_asok")
+        return os.path.join(d, f"{self.name}.asok")
+
+    def start_admin_socket(self) -> AdminSocket:
+        if self._admin is None:
+            self._admin = AdminSocket(self.admin_socket_path)
+            wire_defaults(self._admin, config=self.conf,
+                          perf=self.perf, logcore=self.log)
+            self._admin.start()
+        return self._admin
+
+    def shutdown(self) -> None:
+        if self._admin is not None:
+            self._admin.shutdown()
+            self._admin = None

@@ -1,0 +1,136 @@
+"""Perf counters — per-daemon metrics with a process registry.
+
+The role of src/common/perf_counters.{h,cc}: a ``PerfCountersBuilder``
+declares typed counters (u64 gauge/counter, time, averages with
+count+sum, histograms), daemons bump them on hot paths (cheap,
+lock-per-instance), and the admin socket's ``perf dump`` serializes
+every collection (perf_counters.h:63-141 / PerfCountersCollection).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+U64 = "u64"          # monotonically increasing counter
+GAUGE = "gauge"      # settable level
+TIME = "time"        # accumulated seconds
+AVG = "avg"          # (count, sum) pair -> mean on dump
+HISTOGRAM = "hist"   # fixed power-of-two bucket counts
+
+
+class PerfCounters:
+    def __init__(self, name: str):
+        self.name = name
+        self._types: Dict[str, str] = {}
+        self._values: Dict[str, float] = {}
+        self._avgs: Dict[str, Tuple[int, float]] = {}
+        self._hists: Dict[str, List[int]] = {}
+        self._lock = threading.Lock()
+
+    # -- declaration (PerfCountersBuilder) ----------------------------
+    def add_u64_counter(self, key: str, desc: str = "") -> None:
+        self._types[key] = U64
+        self._values[key] = 0
+
+    def add_u64(self, key: str, desc: str = "") -> None:
+        self._types[key] = GAUGE
+        self._values[key] = 0
+
+    def add_time(self, key: str, desc: str = "") -> None:
+        self._types[key] = TIME
+        self._values[key] = 0.0
+
+    def add_u64_avg(self, key: str, desc: str = "") -> None:
+        self._types[key] = AVG
+        self._avgs[key] = (0, 0.0)
+
+    def add_histogram(self, key: str, buckets: int = 32,
+                      desc: str = "") -> None:
+        self._types[key] = HISTOGRAM
+        self._hists[key] = [0] * buckets
+
+    # -- updates ------------------------------------------------------
+    def inc(self, key: str, amount: float = 1) -> None:
+        with self._lock:
+            self._values[key] += amount
+
+    def dec(self, key: str, amount: float = 1) -> None:
+        assert self._types[key] == GAUGE
+        with self._lock:
+            self._values[key] -= amount
+
+    def set(self, key: str, value: float) -> None:
+        with self._lock:
+            self._values[key] = value
+
+    def tinc(self, key: str, seconds: float) -> None:
+        assert self._types[key] == TIME
+        with self._lock:
+            self._values[key] += seconds
+
+    def avg_add(self, key: str, value: float) -> None:
+        assert self._types[key] == AVG
+        with self._lock:
+            n, s = self._avgs[key]
+            self._avgs[key] = (n + 1, s + value)
+
+    def hist_add(self, key: str, value: float) -> None:
+        assert self._types[key] == HISTOGRAM
+        hist = self._hists[key]
+        bucket = min(len(hist) - 1, max(0, int(value).bit_length()))
+        with self._lock:
+            hist[bucket] += 1
+
+    # -- dump ---------------------------------------------------------
+    def dump(self) -> Dict:
+        with self._lock:
+            out: Dict = {}
+            for key, t in self._types.items():
+                if t == AVG:
+                    n, s = self._avgs[key]
+                    out[key] = {"avgcount": n, "sum": s,
+                                "avg": (s / n) if n else 0.0}
+                elif t == HISTOGRAM:
+                    out[key] = {"buckets": list(self._hists[key])}
+                else:
+                    out[key] = self._values[key]
+            return out
+
+
+class PerfCountersCollection:
+    """Process-wide registry (PerfCountersCollectionImpl)."""
+
+    def __init__(self):
+        self._loggers: Dict[str, PerfCounters] = {}
+        self._lock = threading.Lock()
+
+    def add(self, counters: PerfCounters) -> None:
+        with self._lock:
+            self._loggers[counters.name] = counters
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._loggers.pop(name, None)
+
+    def create(self, name: str) -> PerfCounters:
+        pc = PerfCounters(name)
+        self.add(pc)
+        return pc
+
+    def dump(self, logger: Optional[str] = None) -> Dict:
+        """The `perf dump` admin-socket payload."""
+        with self._lock:
+            items = ({logger: self._loggers[logger]}
+                     if logger else dict(self._loggers))
+        return {name: pc.dump() for name, pc in items.items()}
+
+
+_collection: Optional[PerfCountersCollection] = None
+
+
+def collection() -> PerfCountersCollection:
+    global _collection
+    if _collection is None:
+        _collection = PerfCountersCollection()
+    return _collection

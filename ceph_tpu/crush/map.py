@@ -270,6 +270,10 @@ class CrushMap:
                     cam[e["bucket_index"]] = ChooseArg(
                         ids=e.get("ids"),
                         weight_set=e.get("weight_set"))
+                # JSON stringifies int keys (pool ids); OSDMap looks
+                # choose_args up by int, so convert back
+                if isinstance(key, str) and key.lstrip("-").isdigit():
+                    key = int(key)
                 m.choose_args[key] = cam
         return m
 

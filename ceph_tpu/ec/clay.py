@@ -326,15 +326,16 @@ class ErasureCodeClay(ErasureCode):
         grid: Dict[int, np.ndarray] = {}
         for i in range(self.k + self.m):
             node = i if i < self.k else i + self.nu
-            if i not in chunks_avail:
+            enc = self.chunk_index(i)  # encoded-position remap
+            if enc not in chunks_avail:
                 erased.add(node)
-            grid[node] = np.array(np.asarray(decoded[i], np.uint8))
+            grid[node] = np.array(np.asarray(decoded[enc], np.uint8))
         for i in range(self.k, self.k + self.nu):
             grid[i] = np.zeros(chunk_size, np.uint8)
         self._decode_layered(erased, grid)
         for i in range(self.k + self.m):
             node = i if i < self.k else i + self.nu
-            decoded[i] = grid[node]
+            decoded[self.chunk_index(i)] = grid[node]
 
     # -- repair path (:302-645) ----------------------------------------
     def is_repair(self, want_to_read: Set[int],

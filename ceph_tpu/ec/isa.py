@@ -101,12 +101,15 @@ class ErasureCodeIsa(ErasureCode):
     def decode_chunks(self, want_to_read, chunks, decoded) -> None:
         import numpy as np
 
-        erased = [i for i in range(self.k + self.m) if i not in chunks]
-        out = self._code.decode(erased,
-                                {i: np.asarray(c, np.uint8)
-                                 for i, c in chunks.items()})
+        # encoded-position -> internal remap, symmetric with encode
+        n = self.k + self.m
+        inv = {self.chunk_index(i): i for i in range(n)}
+        avail = {inv[c]: np.asarray(v, np.uint8)
+                 for c, v in chunks.items()}
+        erased = [i for i in range(n) if i not in avail]
+        out = self._code.decode(erased, avail)
         for i, buf in out.items():
-            decoded[i] = np.asarray(buf)
+            decoded[self.chunk_index(i)] = np.asarray(buf)
 
 
 def make_isa(profile: ErasureCodeProfile) -> ErasureCodeIsa:

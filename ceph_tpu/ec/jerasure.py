@@ -114,12 +114,17 @@ class ErasureCodeJerasure(ErasureCode):
     def decode_chunks(self, want_to_read: Set[int],
                       chunks: Dict[int, np.ndarray],
                       decoded: Dict[int, np.ndarray]) -> None:
-        erased = [i for i in range(self.k + self.m) if i not in chunks]
-        out = self._code.decode(erased,
-                                {i: np.asarray(c, np.uint8)
-                                 for i, c in chunks.items()})
+        # chunks/decoded are keyed by ENCODED position; the engine
+        # works in internal (data-first) order — remap symmetrically
+        # with encode_chunks so mapping= profiles decode correctly
+        n = self.k + self.m
+        inv = {self.chunk_index(i): i for i in range(n)}
+        avail = {inv[c]: np.asarray(v, np.uint8)
+                 for c, v in chunks.items()}
+        erased = [i for i in range(n) if i not in avail]
+        out = self._code.decode(erased, avail)
         for i, buf in out.items():
-            decoded[i] = np.asarray(buf)
+            decoded[self.chunk_index(i)] = np.asarray(buf)
 
 
 class _MatrixTechnique(ErasureCodeJerasure):

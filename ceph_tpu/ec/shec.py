@@ -289,8 +289,13 @@ class ErasureCodeShec(ErasureCode):
     def decode_chunks(self, want_to_read: Set[int],
                       chunks: Dict[int, np.ndarray],
                       decoded: Dict[int, np.ndarray]) -> None:
-        """shec_matrix_decode (:757-814) on the bit engine."""
+        """shec_matrix_decode (:757-814) on the bit engine.  Encoded
+        positions remap to internal (data-first) order symmetrically
+        with encode_chunks."""
         n = self.k + self.m
+        inv = {self.chunk_index(i): i for i in range(n)}
+        chunks = {inv[c]: v for c, v in chunks.items()}
+        want_to_read = {inv[c] for c in want_to_read}
         want = [0] * n
         avails = [0] * n
         for i in want_to_read:
@@ -321,14 +326,15 @@ class ErasureCodeShec(ErasureCode):
                 len(need_idx), L)
             out = np.asarray(out)
             for idx, i in enumerate(need_idx):
-                decoded[cols[i]] = out[idx]
+                decoded[self.chunk_index(cols[i])] = out[idx]
         # re-encode WANTED erased parity from the (recovered) data it
         # touches (:807-812)
         erased_parity = [i for i in range(self.m)
                          if want[self.k + i] and not avails[self.k + i]]
         if erased_parity:
-            data = np.stack([np.asarray(decoded[j], np.uint8)
-                             for j in range(self.k)])
+            data = np.stack(
+                [np.asarray(decoded[self.chunk_index(j)], np.uint8)
+                 for j in range(self.k)])
             bm = self._gf.expand_bitmatrix(
                 [self.matrix[i] for i in erased_parity])
             L = data.shape[1]
@@ -338,7 +344,7 @@ class ErasureCodeShec(ErasureCode):
                 len(erased_parity), L)
             out = np.asarray(out)
             for idx, i in enumerate(erased_parity):
-                decoded[self.k + i] = out[idx]
+                decoded[self.chunk_index(self.k + i)] = out[idx]
 
 
 def make_shec(profile: ErasureCodeProfile) -> ErasureCodeShec:

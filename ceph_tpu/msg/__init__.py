@@ -1,0 +1,12 @@
+"""Cluster fabric — the reference's src/msg surface, re-scoped.
+
+The reference's AsyncMessenger carries BOTH bulk data and control
+traffic over TCP (ProtocolV2, epoll workers).  TPU-native, the bulk
+data plane is XLA collectives over ICI/DCN inside compiled programs
+(``ceph_tpu.parallel``) — so what remains host-side is the control
+plane: map epochs, heartbeats, shard fetch/push for recovery.
+``messenger.Messenger`` is that plane: a threaded TCP transport with
+length-prefixed JSON messages, typed dispatch, and reconnecting
+send — the Messenger/Dispatcher seam (src/msg/Messenger.h,
+Dispatcher.h) sized to its remaining job.
+"""

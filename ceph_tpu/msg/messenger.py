@@ -1,0 +1,202 @@
+"""Messenger — threaded TCP transport with typed JSON dispatch.
+
+The Messenger/Dispatcher seam (src/msg/Messenger.h, Dispatcher.h,
+AsyncMessenger.cc) for the host control plane.  Framing: 4-byte
+big-endian length + JSON body (binary payloads travel hex-encoded —
+control-plane sizes, not data-plane).  Each messenger owns an accept
+thread and per-connection reader threads; ``send`` opens (and caches)
+client connections and is fire-and-forget; ``call`` is send + wait for
+a reply correlated by ``tid`` (the MOSDOp/reply pattern).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+import uuid
+from typing import Callable, Dict, Optional, Tuple
+
+Addr = Tuple[str, int]
+Handler = Callable[[Dict], Optional[Dict]]
+
+
+def _send_frame(sock: socket.socket, msg: Dict) -> None:
+    body = json.dumps(msg).encode()
+    sock.sendall(struct.pack(">I", len(body)) + body)
+
+
+def _recv_frame(sock: socket.socket) -> Optional[Dict]:
+    header = b""
+    while len(header) < 4:
+        got = sock.recv(4 - len(header))
+        if not got:
+            return None
+        header += got
+    (length,) = struct.unpack(">I", header)
+    body = b""
+    while len(body) < length:
+        got = sock.recv(min(65536, length - len(body)))
+        if not got:
+            return None
+        body += got
+    return json.loads(body.decode())
+
+
+class Messenger:
+    def __init__(self, name: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.name = name
+        self._handlers: Dict[str, Handler] = {}
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(32)
+        self._listener.settimeout(0.2)
+        self.addr: Addr = self._listener.getsockname()
+        self._running = False
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: Dict[Addr, socket.socket] = {}
+        self._conn_lock = threading.Lock()
+        self._pending: Dict[str, Dict] = {}
+        self._waiting: set = set()  # tids with a live waiter
+        self._pending_cv = threading.Condition()
+
+    # -- dispatch ------------------------------------------------------
+    def register(self, type_: str, handler: Handler) -> None:
+        """Handler returns a reply dict (routed back by tid) or None."""
+        self._handlers[type_] = handler
+
+    def start(self) -> None:
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"msgr:{self.name}")
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._reader, args=(conn,),
+                             daemon=True).start()
+
+    def _reader(self, conn: socket.socket) -> None:
+        with conn:
+            while self._running:
+                try:
+                    msg = _recv_frame(conn)
+                except OSError:
+                    break
+                if msg is None:
+                    break
+                self._dispatch(conn, msg)
+
+    def _dispatch(self, conn: socket.socket, msg: Dict) -> None:
+        type_ = msg.get("type", "")
+        if type_ == "__reply__":
+            with self._pending_cv:
+                if msg["tid"] in self._waiting:  # drop stragglers
+                    self._pending[msg["tid"]] = msg.get("payload", {})
+                    self._pending_cv.notify_all()
+            return
+        handler = self._handlers.get(type_)
+        if handler is None:
+            reply = {"error": f"no handler for {type_!r}"}
+        else:
+            try:
+                reply = handler(msg)
+            except Exception as e:
+                reply = {"error": str(e)}
+        if msg.get("tid") is not None:
+            try:
+                _send_frame(conn, {"type": "__reply__",
+                                   "tid": msg["tid"],
+                                   "payload": reply})
+            except OSError:
+                pass
+
+    # -- client side ---------------------------------------------------
+    def _connect(self, addr: Addr) -> socket.socket:
+        addr = tuple(addr)
+        with self._conn_lock:
+            sock = self._conns.get(addr)
+            if sock is not None:
+                return sock
+            sock = socket.create_connection(addr, timeout=5)
+            self._conns[addr] = sock
+            threading.Thread(target=self._reader, args=(sock,),
+                             daemon=True).start()
+            return sock
+
+    def _drop(self, addr: Addr) -> None:
+        with self._conn_lock:
+            sock = self._conns.pop(tuple(addr), None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def send(self, addr: Addr, msg: Dict) -> None:
+        """Fire-and-forget; one silent reconnect attempt (lossy
+        policy)."""
+        for _ in range(2):
+            try:
+                _send_frame(self._connect(addr), msg)
+                return
+            except OSError:
+                self._drop(addr)
+
+    def call(self, addr: Addr, msg: Dict,
+             timeout: float = 10.0) -> Dict:
+        """Request/response correlated by tid.  A timeout does NOT
+        close the (shared) connection — other in-flight calls on the
+        same peer keep their replies; a genuinely dead socket raises
+        OSError on the next send and is reconnected there."""
+        tid = uuid.uuid4().hex
+        msg = dict(msg, tid=tid, frm=self.name)
+        deadline = time.monotonic() + timeout
+        with self._pending_cv:
+            self._waiting.add(tid)
+        try:
+            _send_frame(self._connect(addr), msg)
+            with self._pending_cv:
+                while tid not in self._pending:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._pending_cv.wait(
+                            timeout=min(0.5, remaining)):
+                        if time.monotonic() >= deadline:
+                            raise TimeoutError(
+                                f"{self.name}: no reply from {addr} "
+                                f"for {msg['type']}")
+                return self._pending.pop(tid)
+        except OSError:
+            self._drop(addr)
+            raise
+        finally:
+            with self._pending_cv:
+                self._waiting.discard(tid)
+                self._pending.pop(tid, None)
+
+    def shutdown(self) -> None:
+        self._running = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            for sock in self._conns.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._conns.clear()

@@ -1,0 +1,9 @@
+"""Local object persistence — the reference's src/os surface.
+
+``ObjectStore`` / ``Transaction`` (src/os/ObjectStore.h,
+src/os/Transaction.h): transactional collections of named objects with
+byte extents, attrs and omap.  ``MemStore`` is the in-RAM backend the
+test tiers build on (src/os/memstore — SURVEY §4 explicitly calls for
+it); services persist EC shards through this API so a disk-backed
+store can slot in behind the same transactions.
+"""

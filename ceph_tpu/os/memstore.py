@@ -1,0 +1,214 @@
+"""MemStore — the in-RAM ObjectStore backend.
+
+The role of src/os/memstore/MemStore.{h,cc}: a dict-of-dicts store
+applying transactions under one lock (transactions are small; the OSD
+serializes per-PG anyway).  Atomicity: ops are applied to a shallow
+working copy of the touched objects and swapped in only when every op
+succeeded — a failed op leaves the store untouched (the
+queue_transaction contract recovery relies on).
+
+``export_state``/``import_state`` serialize the whole store — the
+checkpoint/restart path the OSD-analogue service uses as its
+superblock+journal stand-in.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from .objectstore import (ObjectStore, Transaction, OP_CLONE, OP_MKCOLL,
+                          OP_OMAP_CLEAR, OP_OMAP_RMKEYS,
+                          OP_OMAP_SETKEYS, OP_REMOVE, OP_RMATTR,
+                          OP_RMCOLL, OP_SETATTR, OP_TOUCH, OP_TRUNCATE,
+                          OP_WRITE, OP_ZERO)
+
+
+class _Object:
+    __slots__ = ("data", "xattr", "omap")
+
+    def __init__(self):
+        self.data = bytearray()
+        self.xattr: Dict[str, bytes] = {}
+        self.omap: Dict[str, bytes] = {}
+
+    def clone(self) -> "_Object":
+        o = _Object()
+        o.data = bytearray(self.data)
+        o.xattr = dict(self.xattr)
+        o.omap = dict(self.omap)
+        return o
+
+
+class TransactionError(Exception):
+    pass
+
+
+class MemStore(ObjectStore):
+    def __init__(self):
+        self._coll: Dict[str, Dict[str, _Object]] = {}
+        self._lock = threading.RLock()
+
+    # -- transaction application --------------------------------------
+    def queue_transaction(self, txn: Transaction) -> None:
+        with self._lock:
+            staged = {cid: dict(objs)
+                      for cid, objs in self._coll.items()}
+            for op in txn.ops:
+                self._apply(staged, op)
+            self._coll = staged
+
+    def _obj(self, staged, cid: str, oid: str,
+             create: bool = False) -> _Object:
+        if cid not in staged:
+            raise TransactionError(f"no collection {cid!r}")
+        objs = staged[cid]
+        o = objs.get(oid)
+        if o is None:
+            if not create:
+                raise TransactionError(f"no object {cid}/{oid}")
+            o = _Object()
+            objs[oid] = o
+        else:
+            # copy-on-write: staged holds shallow copies of the
+            # collection dicts; objects mutate via private clones
+            o = o.clone()
+            objs[oid] = o
+        return o
+
+    def _apply(self, staged, op) -> None:
+        kind = op[0]
+        if kind == OP_MKCOLL:
+            _, cid = op
+            if cid in staged:
+                raise TransactionError(f"collection {cid!r} exists")
+            staged[cid] = {}
+        elif kind == OP_RMCOLL:
+            _, cid = op
+            if staged.get(cid):
+                raise TransactionError(f"collection {cid!r} not empty")
+            if cid not in staged:
+                raise TransactionError(f"no collection {cid!r}")
+            del staged[cid]
+        elif kind == OP_TOUCH:
+            _, cid, oid = op
+            self._obj(staged, cid, oid, create=True)
+        elif kind == OP_WRITE:
+            _, cid, oid, offset, data = op
+            o = self._obj(staged, cid, oid, create=True)
+            end = offset + len(data)
+            if len(o.data) < end:
+                o.data.extend(b"\0" * (end - len(o.data)))
+            o.data[offset:end] = data
+        elif kind == OP_ZERO:
+            _, cid, oid, offset, length = op
+            # extends past EOF like the reference's _zero-via-_write
+            o = self._obj(staged, cid, oid)
+            end = offset + length
+            if len(o.data) < end:
+                o.data.extend(b"\0" * (end - len(o.data)))
+            o.data[offset:end] = b"\0" * (end - offset)
+        elif kind == OP_TRUNCATE:
+            _, cid, oid, size = op
+            o = self._obj(staged, cid, oid)
+            if len(o.data) > size:
+                del o.data[size:]
+            else:
+                o.data.extend(b"\0" * (size - len(o.data)))
+        elif kind == OP_REMOVE:
+            _, cid, oid = op
+            if cid not in staged or oid not in staged[cid]:
+                raise TransactionError(f"no object {cid}/{oid}")
+            del staged[cid][oid]
+        elif kind == OP_CLONE:
+            _, cid, src, dst = op
+            o = self._obj(staged, cid, src)
+            staged[cid][dst] = o.clone()
+        elif kind == OP_SETATTR:
+            _, cid, oid, key, value = op
+            self._obj(staged, cid, oid, create=True).xattr[key] = value
+        elif kind == OP_RMATTR:
+            _, cid, oid, key = op
+            self._obj(staged, cid, oid).xattr.pop(key, None)
+        elif kind == OP_OMAP_SETKEYS:
+            _, cid, oid, kv = op
+            self._obj(staged, cid, oid, create=True).omap.update(kv)
+        elif kind == OP_OMAP_RMKEYS:
+            _, cid, oid, keys = op
+            o = self._obj(staged, cid, oid)
+            for k in keys:
+                o.omap.pop(k, None)
+        elif kind == OP_OMAP_CLEAR:
+            _, cid, oid = op
+            self._obj(staged, cid, oid).omap.clear()
+        else:
+            raise TransactionError(f"unknown op {kind!r}")
+
+    # -- reads --------------------------------------------------------
+    def read(self, cid: str, oid: str, offset: int = 0,
+             length: int = -1) -> bytes:
+        with self._lock:
+            o = self._coll.get(cid, {}).get(oid)
+            if o is None:
+                raise KeyError(f"no object {cid}/{oid}")
+            if length < 0:
+                return bytes(o.data[offset:])
+            return bytes(o.data[offset:offset + length])
+
+    def stat(self, cid: str, oid: str) -> Optional[Dict]:
+        with self._lock:
+            o = self._coll.get(cid, {}).get(oid)
+            if o is None:
+                return None
+            return {"size": len(o.data), "xattrs": len(o.xattr),
+                    "omap_keys": len(o.omap)}
+
+    def getattr(self, cid: str, oid: str, key: str) -> Optional[bytes]:
+        with self._lock:
+            o = self._coll.get(cid, {}).get(oid)
+            return None if o is None else o.xattr.get(key)
+
+    def omap_get(self, cid: str, oid: str) -> Dict[str, bytes]:
+        with self._lock:
+            o = self._coll.get(cid, {}).get(oid)
+            return dict(o.omap) if o is not None else {}
+
+    def list_collections(self) -> List[str]:
+        with self._lock:
+            return sorted(self._coll)
+
+    def list_objects(self, cid: str) -> List[str]:
+        with self._lock:
+            return sorted(self._coll.get(cid, {}))
+
+    def collection_exists(self, cid: str) -> bool:
+        with self._lock:
+            return cid in self._coll
+
+    # -- checkpoint/restart -------------------------------------------
+    def export_state(self) -> Dict:
+        with self._lock:
+            return {
+                cid: {oid: {"data": bytes(o.data).hex(),
+                            "xattr": {k: v.hex()
+                                      for k, v in o.xattr.items()},
+                            "omap": {k: v.hex()
+                                     for k, v in o.omap.items()}}
+                      for oid, o in objs.items()}
+                for cid, objs in self._coll.items()
+            }
+
+    @classmethod
+    def import_state(cls, state: Dict) -> "MemStore":
+        st = cls()
+        for cid, objs in state.items():
+            st._coll[cid] = {}
+            for oid, od in objs.items():
+                o = _Object()
+                o.data = bytearray(bytes.fromhex(od["data"]))
+                o.xattr = {k: bytes.fromhex(v)
+                           for k, v in od["xattr"].items()}
+                o.omap = {k: bytes.fromhex(v)
+                          for k, v in od["omap"].items()}
+                st._coll[cid][oid] = o
+        return st

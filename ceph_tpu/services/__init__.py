@@ -1,0 +1,14 @@
+"""Distributed services — the daemons layer (reference src/mon,
+src/osd), single-host scale.
+
+- ``monitor``: the cluster-map authority — versioned OSDMap epochs
+  (MonitorDBStore role), osd boot/heartbeat tracking, failure
+  detection (mark-down on heartbeat grace), map push to subscribers.
+- ``osd_service``: the OSD analogue — MemStore-backed shard storage,
+  EC data path, heartbeats, and mark-down→remap→recover backfill.
+- ``client``: the librados analogue — client-side placement
+  (pg_to_up_acting_osds on its own map copy), EC encode/decode.
+- ``cluster``: the vstart.sh-style harness: one call brings up a mon
+  and N osds on localhost sockets (many daemons, one host — the
+  reference's qa/standalone model), plus the thrasher hooks.
+"""

@@ -1,0 +1,185 @@
+"""Client — the librados/Objecter analogue.
+
+Placement is CLIENT-SIDE and stateless, exactly as in the reference
+(Objecter::_calc_target, src/osdc/Objecter.cc:2688): the client holds
+its own OSDMap copy, computes object→PG→OSD mappings locally
+(pg_to_up_acting_osds), EC-encodes on write and fans shards out to the
+up set positionally; reads gather any k shards and decode.  On a stale
+map (peer down / remapped), it refreshes from the mon and retries —
+the map-epoch retry loop every RADOS op runs.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..msg.messenger import Addr, Messenger
+from ..osdmap.osdmap import OSDMap, POOL_TYPE_ERASURE
+from ..ec.registry import profile_factory
+
+
+def object_to_ps(oid: str) -> int:
+    """object name -> placement seed.  The reference uses
+    ceph_str_hash_rjenkins (object_locator_to_pg); any fixed 32-bit
+    hash yields the same placement *semantics* — this one is
+    sha256-low32, framework-defined and stable."""
+    return int.from_bytes(
+        hashlib.sha256(oid.encode()).digest()[:4], "little")
+
+
+class Client:
+    def __init__(self, name: str, mon_addr: Addr,
+                 host: str = "127.0.0.1"):
+        self.name = name
+        self.mon_addr = tuple(mon_addr)
+        self.msgr = Messenger(f"client.{name}", host, 0)
+        self.msgr.register("map_update", self._h_map_update)
+        self.msgr.start()
+        self.map: Optional[OSDMap] = None
+        self.epoch = 0
+        self.osd_addrs: Dict[int, Addr] = {}
+        self.ec_profiles: Dict[str, Dict[str, str]] = {}
+        self._codes: Dict[str, object] = {}
+        self._lock = threading.RLock()
+        payload = self.msgr.call(self.mon_addr,
+                                 {"type": "subscribe",
+                                  "name": f"client.{name}",
+                                  "addr": list(self.msgr.addr)})
+        self._install_map(payload)
+
+    def shutdown(self) -> None:
+        self.msgr.shutdown()
+
+    # -- map -----------------------------------------------------------
+    def _install_map(self, payload: Dict) -> None:
+        with self._lock:
+            if payload["epoch"] <= self.epoch:
+                return
+            self.map = OSDMap.from_dict(payload["map"])
+            self.epoch = payload["epoch"]
+            self.osd_addrs = {int(k): tuple(v) for k, v in
+                              payload.get("osd_addrs", {}).items()}
+            self.ec_profiles = payload.get("ec_profiles", {})
+
+    def _h_map_update(self, msg: Dict) -> None:
+        self._install_map(msg["payload"])
+        return None
+
+    def refresh_map(self) -> None:
+        self._install_map(self.msgr.call(self.mon_addr,
+                                         {"type": "get_map"}))
+
+    def _code_for(self, pool):
+        if pool.pool_type != POOL_TYPE_ERASURE:
+            return None
+        name = pool.erasure_code_profile
+        code = self._codes.get(name)
+        if code is None:
+            code = profile_factory(dict(self.ec_profiles[name]))
+            self._codes[name] = code
+        return code
+
+    def _up(self, pool_id: int, oid: str):
+        pool = self.map.pools[pool_id]
+        ps = object_to_ps(oid) % pool.pg_num
+        up, _p, _a, _ap = self.map.pg_to_up_acting_osds(pool_id, ps)
+        return pool, ps, up
+
+    # -- data path -------------------------------------------------------
+    def put(self, pool_id: int, oid: str, data: bytes,
+            retries: int = 3) -> None:
+        for attempt in range(retries):
+            pool, ps, up = self._up(pool_id, oid)
+            code = self._code_for(pool)
+            try:
+                if code is None:
+                    for pos, osd in enumerate(up):
+                        self._write_shard(pool_id, ps, oid, osd, 0,
+                                          data, len(data))
+                else:
+                    n = code.get_chunk_count()
+                    chunks = code.encode(range(n), data)
+                    if len(up) < n:
+                        raise TimeoutError("degraded up set for write")
+                    for pos in range(n):
+                        self._write_shard(
+                            pool_id, ps, oid, up[pos], pos,
+                            np.asarray(chunks[pos],
+                                       np.uint8).tobytes(),
+                            len(data))
+                return
+            except (TimeoutError, OSError):
+                if attempt + 1 == retries:
+                    raise
+                time.sleep(0.3)
+                self.refresh_map()
+
+    def _write_shard(self, pool_id, ps, oid, osd, shard, data,
+                     size) -> None:
+        got = self.msgr.call(self.osd_addrs[osd],
+                             {"type": "shard_write", "pool": pool_id,
+                              "ps": ps, "oid": oid, "shard": shard,
+                              "data": data.hex(), "size": size},
+                             timeout=10)
+        if not got.get("ok"):
+            raise OSError(f"shard_write to osd.{osd}: {got}")
+
+    def get(self, pool_id: int, oid: str, retries: int = 3) -> bytes:
+        for attempt in range(retries):
+            pool, ps, up = self._up(pool_id, oid)
+            code = self._code_for(pool)
+            try:
+                if code is None:
+                    return self._read_replicated(pool_id, ps, oid, up)
+                return self._read_ec(pool_id, ps, oid, up, code)
+            except (TimeoutError, OSError, KeyError):
+                if attempt + 1 == retries:
+                    raise
+                time.sleep(0.3)
+                self.refresh_map()
+        raise OSError("unreachable")
+
+    def _read_replicated(self, pool_id, ps, oid, up) -> bytes:
+        last: Exception = OSError("empty up set")
+        for osd in up:
+            try:
+                got = self.msgr.call(
+                    self.osd_addrs[osd],
+                    {"type": "shard_read", "pool": pool_id, "ps": ps,
+                     "oid": oid, "shard": 0}, timeout=5)
+            except (TimeoutError, OSError, KeyError) as e:
+                last = e
+                continue
+            if "data" in got:
+                return bytes.fromhex(got["data"])[:got["size"]]
+        raise last
+
+    def _read_ec(self, pool_id, ps, oid, up, code) -> bytes:
+        """Gather any k shards (degraded reads ride the same path the
+        reference's objects_read_and_reconstruct does)."""
+        k = code.get_data_chunk_count()
+        chunks: Dict[int, np.ndarray] = {}
+        size = None
+        for pos, osd in enumerate(up):
+            if len(chunks) >= k:
+                break
+            try:
+                got = self.msgr.call(
+                    self.osd_addrs[osd],
+                    {"type": "shard_read", "pool": pool_id, "ps": ps,
+                     "oid": oid, "shard": pos}, timeout=5)
+            except (TimeoutError, OSError, KeyError):
+                continue
+            if "data" in got:
+                chunks[pos] = np.frombuffer(
+                    bytes.fromhex(got["data"]), np.uint8)
+                size = got["size"]
+        if len(chunks) < k or size is None:
+            raise TimeoutError(
+                f"only {len(chunks)}/{k} shards reachable for {oid}")
+        return code.decode_concat(chunks)[:size]

@@ -1,0 +1,153 @@
+"""MiniCluster — the vstart.sh / qa/standalone harness.
+
+The reference tests "multi-node" behavior with many daemons on one
+host (src/vstart.sh, qa/standalone/ceph-helpers.sh run_mon/run_osd/
+wait_for_clean).  MiniCluster is that harness: one call boots a
+monitor and N OSD services on localhost sockets, builds the CRUSH
+hierarchy through the facade, creates pools/EC profiles through mon
+commands, and exposes the thrasher hooks (kill_osd / revive_osd /
+wait_for_down / wait_for_recovery) that qa/tasks/thrashosds.py
+provides in the reference.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ..common.config import Config
+from ..common.context import Context
+from ..crush.wrapper import CrushWrapper
+from ..osdmap.osdmap import (OSDMap, PgPool, POOL_TYPE_ERASURE,
+                             POOL_TYPE_REPLICATED)
+from .client import Client
+from .monitor import Monitor
+from .osd_service import OSDService
+
+
+class MiniCluster:
+    def __init__(self, n_osds: int = 4, hosts: Optional[int] = None,
+                 config: Optional[Config] = None):
+        self.conf = config or Config()
+        self.n_osds = n_osds
+        hosts = hosts or n_osds
+        # crush hierarchy through the facade (one host per fd bucket)
+        self.wrapper = CrushWrapper()
+        for d in range(n_osds):
+            self.wrapper.insert_item(
+                d, 0x10000, f"osd.{d}",
+                {"host": f"host{d % hosts}", "root": "default"})
+        self.replicated_rule = self.wrapper.add_simple_rule(
+            "replicated_rule", "default", "host", "", "firstn")
+        self.ec_rule = self.wrapper.add_simple_rule(
+            "ec_rule", "default", "host", "", "indep", rule_type=3)
+
+        osdmap = OSDMap(self.wrapper.crush)
+        self.mon_ctx = Context("mon", config=self.conf)
+        self.mon = Monitor(self.mon_ctx, osdmap)
+        self.osds: Dict[int, OSDService] = {}
+        self.clients: List[Client] = []
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "MiniCluster":
+        self.mon.start()
+        for d in range(self.n_osds):
+            self.revive_osd(d)
+        return self
+
+    def shutdown(self) -> None:
+        for c in self.clients:
+            c.shutdown()
+        for svc in list(self.osds.values()):
+            svc.shutdown()
+        self.mon.shutdown()
+
+    def client(self, name: str = "admin") -> Client:
+        c = Client(name, self.mon.addr)
+        self.clients.append(c)
+        return c
+
+    # -- pool / profile management (mon command surface) ---------------
+    def create_replicated_pool(self, pool_id: int, pg_num: int = 8,
+                               size: int = 3) -> None:
+        self.mon.msgr.call(self.mon.addr, {
+            "type": "pool_create", "pool_id": pool_id,
+            "pool": {"pool_type": POOL_TYPE_REPLICATED, "size": size,
+                     "min_size": max(1, size - 1), "pg_num": pg_num,
+                     "crush_rule": self.replicated_rule}})
+
+    def create_ec_pool(self, pool_id: int, profile_name: str,
+                       profile: Dict[str, str],
+                       pg_num: int = 8) -> None:
+        self.mon.msgr.call(self.mon.addr, {
+            "type": "ec_profile_set", "name": profile_name,
+            "profile": profile})
+        from ..ec.registry import profile_factory
+
+        code = profile_factory(dict(profile))
+        self.mon.msgr.call(self.mon.addr, {
+            "type": "pool_create", "pool_id": pool_id,
+            "pool": {"pool_type": POOL_TYPE_ERASURE,
+                     "size": code.get_chunk_count(),
+                     "min_size": code.get_data_chunk_count(),
+                     "pg_num": pg_num, "crush_rule": self.ec_rule,
+                     "erasure_code_profile": profile_name}})
+
+    # -- thrasher hooks (qa/tasks/thrashosds.py role) -------------------
+    def kill_osd(self, osd: int) -> None:
+        svc = self.osds.pop(osd, None)
+        if svc is not None:
+            svc.shutdown()
+
+    def revive_osd(self, osd: int) -> OSDService:
+        ctx = Context(f"osd.{osd}", config=self.conf)
+        svc = OSDService(ctx, osd, self.mon.addr)
+        svc.start()
+        self.osds[osd] = svc
+        return svc
+
+    def status(self) -> Dict:
+        return self.mon.msgr.call(self.mon.addr, {"type": "status"})
+
+    def wait_for_down(self, osd: int, timeout: float = 15.0) -> None:
+        self._wait(lambda: osd not in self.status()["up_osds"],
+                   timeout, f"osd.{osd} still up")
+
+    def wait_for_up(self, osd: int, timeout: float = 15.0) -> None:
+        self._wait(lambda: osd in self.status()["up_osds"],
+                   timeout, f"osd.{osd} still down")
+
+    def wait_for_recovery(self, pool_id: int, objects: Dict[str, int],
+                          timeout: float = 30.0) -> None:
+        """wait_for_clean: every up-set shard of every object present
+        on the OSD that should hold it."""
+        def clean() -> bool:
+            payload = self.mon.msgr.call(self.mon.addr,
+                                         {"type": "get_map"})
+            m = OSDMap.from_dict(payload["map"])
+            pool = m.pools[pool_id]
+            from .client import object_to_ps
+            for oid in objects:
+                ps = object_to_ps(oid) % pool.pg_num
+                up, _p, _a, _ap = m.pg_to_up_acting_osds(pool_id, ps)
+                for pos, osd in enumerate(up):
+                    svc = self.osds.get(osd)
+                    if svc is None:
+                        return False
+                    shard = pos if pool.pool_type == \
+                        POOL_TYPE_ERASURE else 0
+                    cid = f"{pool_id}.{ps}"
+                    if svc.store.stat(cid, f"{oid}.s{shard}") is None:
+                        return False
+            return True
+
+        self._wait(clean, timeout, "recovery incomplete")
+
+    @staticmethod
+    def _wait(cond, timeout: float, what: str) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return
+            time.sleep(0.2)
+        raise TimeoutError(what)

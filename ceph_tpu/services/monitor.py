@@ -1,0 +1,233 @@
+"""Monitor — the cluster-map authority and failure detector.
+
+The role of src/mon (Monitor.cc / OSDMonitor.cc / MonitorDBStore.h),
+single-instance: it owns the OSDMap, bumps epochs on every state
+change, retains full maps per epoch (the MonitorDBStore analogue — any
+daemon can resume at any epoch), tracks osd boot/heartbeat liveness,
+and marks osds down after ``osd_heartbeat_grace`` without a beat
+(OSD::handle_osd_ping → OSDMonitor flow, src/osd/OSD.cc:5487 /
+ceph_osd.cc:544).  Map changes push to subscribers (MonClient
+subscription role).
+
+Paxos is consciously replaced by the single authority: the reference
+runs 3+ mons for its OWN availability; the map semantics downstream
+(epochs, incremental catch-up, subscriptions) are what the rest of the
+system consumes and are preserved here.  (SURVEY §2.5 Monitor row.)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..common.context import Context
+from ..msg.messenger import Addr, Messenger
+from ..osdmap.osdmap import OSDMap, PgPool
+
+
+class Monitor:
+    def __init__(self, ctx: Context, osdmap: OSDMap,
+                 host: str = "127.0.0.1", port: int = 0,
+                 store_dir: Optional[str] = None):
+        self.ctx = ctx
+        self.log = ctx.logger("mon")
+        self.map = osdmap
+        self.msgr = Messenger("mon", host, port)
+        self.addr: Addr = self.msgr.addr
+        self.store_dir = store_dir
+        self._epochs: Dict[int, str] = {}  # epoch -> map json
+        self._osd_addrs: Dict[int, Addr] = {}
+        self._last_beat: Dict[int, float] = {}
+        self._down_since: Dict[int, float] = {}
+        self._subscribers: Dict[str, Addr] = {}
+        self._lock = threading.RLock()
+        self._ticker: Optional[threading.Thread] = None
+        self._running = False
+        self.ec_profiles: Dict[str, Dict[str, str]] = {}
+        self.pc = ctx.perf.create("mon")
+        self.pc.add_u64_counter("epochs")
+        self.pc.add_u64_counter("beats")
+        self.pc.add_u64_counter("markdowns")
+
+        for t, h in (("boot", self._h_boot),
+                     ("heartbeat", self._h_heartbeat),
+                     ("get_map", self._h_get_map),
+                     ("subscribe", self._h_subscribe),
+                     ("mark_down", self._h_mark_down),
+                     ("mark_out", self._h_mark_out),
+                     ("pool_create", self._h_pool_create),
+                     ("ec_profile_set", self._h_ec_profile_set),
+                     ("status", self._h_status)):
+            self.msgr.register(t, h)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        self._commit("genesis")
+        self.msgr.start()
+        self._running = True
+        self._ticker = threading.Thread(target=self._tick_loop,
+                                        daemon=True, name="mon-tick")
+        self._ticker.start()
+
+    def shutdown(self) -> None:
+        self._running = False
+        if self._ticker:
+            self._ticker.join(timeout=2)
+        self.msgr.shutdown()
+
+    # -- the epoch store (MonitorDBStore role) --------------------------
+    def _commit(self, why: str) -> int:
+        """Bump the epoch, retain the full map, persist, notify."""
+        with self._lock:
+            self.map.epoch += 1
+            payload = json.dumps(self._map_payload())
+            self._epochs[self.map.epoch] = payload
+            keep = self.ctx.conf["mon_max_map_epochs"]
+            for e in sorted(self._epochs)[:-keep]:
+                del self._epochs[e]
+            if self.store_dir:
+                os.makedirs(self.store_dir, exist_ok=True)
+                with open(os.path.join(
+                        self.store_dir,
+                        f"osdmap.{self.map.epoch}.json"), "w") as f:
+                    f.write(payload)
+            epoch = self.map.epoch
+        self.pc.inc("epochs")
+        self.log.dout(5, f"new epoch {epoch} ({why})")
+        self._push_maps()
+        return epoch
+
+    def _map_payload(self) -> Dict:
+        return {"epoch": self.map.epoch,
+                "map": self.map.to_dict(),
+                "osd_addrs": {str(k): list(v)
+                              for k, v in self._osd_addrs.items()},
+                "ec_profiles": self.ec_profiles}
+
+    def get_epoch_payload(self, epoch: int) -> Optional[Dict]:
+        with self._lock:
+            raw = self._epochs.get(epoch)
+        return json.loads(raw) if raw else None
+
+    def _push_maps(self) -> None:
+        with self._lock:
+            payload = json.loads(self._epochs[self.map.epoch])
+            subs = list(self._subscribers.values())
+        for addr in subs:
+            self.msgr.send(addr, {"type": "map_update",
+                                  "payload": payload})
+
+    # -- handlers --------------------------------------------------------
+    def _h_boot(self, msg: Dict) -> Dict:
+        osd = int(msg["osd"])
+        addr = tuple(msg["addr"])
+        with self._lock:
+            addr_changed = self._osd_addrs.get(osd) != addr
+            self._osd_addrs[osd] = addr
+            self._last_beat[osd] = time.monotonic()
+            existed = self.map.exists(osd) and self.map.is_up(osd)
+            self.map.add_osd(osd, weight=msg.get("weight", 0x10000))
+        if not existed or addr_changed:
+            # a fast reboot keeps the osd "up" but rebinds its socket:
+            # the new address must reach every peer via a new epoch
+            self._commit(f"osd.{osd} boot")
+        self.log.dout(1, f"osd.{osd} booted at {msg['addr']}")
+        return {"epoch": self.map.epoch}
+
+    def _h_heartbeat(self, msg: Dict) -> None:
+        with self._lock:
+            self._last_beat[int(msg["osd"])] = time.monotonic()
+        self.pc.inc("beats")
+        return None
+
+    def _h_get_map(self, msg: Dict) -> Dict:
+        epoch = msg.get("epoch")
+        if epoch is not None:
+            got = self.get_epoch_payload(int(epoch))
+            return got if got is not None else \
+                {"error": f"no epoch {epoch}"}
+        with self._lock:
+            return json.loads(self._epochs[self.map.epoch])
+
+    def _h_subscribe(self, msg: Dict) -> Dict:
+        with self._lock:
+            self._subscribers[msg["name"]] = tuple(msg["addr"])
+            return json.loads(self._epochs[self.map.epoch])
+
+    def _h_mark_down(self, msg: Dict) -> Dict:
+        return {"epoch": self.mark_down(int(msg["osd"]))}
+
+    def _h_mark_out(self, msg: Dict) -> Dict:
+        osd = int(msg["osd"])
+        with self._lock:
+            self.map.osd_weight[osd] = 0
+        return {"epoch": self._commit(f"osd.{osd} out")}
+
+    def _h_pool_create(self, msg: Dict) -> Dict:
+        pool_id = int(msg["pool_id"])
+        with self._lock:
+            self.map.pools[pool_id] = PgPool(**msg["pool"])
+        return {"epoch": self._commit(f"pool {pool_id} create")}
+
+    def _h_ec_profile_set(self, msg: Dict) -> Dict:
+        with self._lock:
+            self.ec_profiles[msg["name"]] = dict(msg["profile"])
+        return {"epoch": self._commit(f"ec profile {msg['name']}")}
+
+    def _h_status(self, _msg: Dict) -> Dict:
+        with self._lock:
+            up = [o for o in range(self.map.max_osd)
+                  if self.map.is_up(o)]
+            return {"epoch": self.map.epoch, "up_osds": up,
+                    "num_pools": len(self.map.pools),
+                    "subscribers": sorted(self._subscribers)}
+
+    # -- failure detection ------------------------------------------------
+    def mark_down(self, osd: int) -> int:
+        from ..osdmap.osdmap import OSD_EXISTS
+
+        with self._lock:
+            if not self.map.is_up(osd):
+                return self.map.epoch
+            self.map.osd_state[osd] = OSD_EXISTS  # up bit cleared
+            self._last_beat.pop(osd, None)
+            self._down_since[osd] = time.monotonic()
+        self.pc.inc("markdowns")
+        self.log.dout(1, f"osd.{osd} marked down")
+        return self._commit(f"osd.{osd} down")
+
+    def _tick_loop(self) -> None:
+        grace = self.ctx.conf["osd_heartbeat_grace"]
+        interval = self.ctx.conf["osd_heartbeat_interval"]
+        out_interval = self.ctx.conf["mon_osd_down_out_interval"]
+        while self._running:
+            time.sleep(interval / 2)
+            now = time.monotonic()
+            stale = []
+            to_out = []
+            with self._lock:
+                for osd, last in self._last_beat.items():
+                    if now - last > grace and self.map.is_up(osd):
+                        stale.append(osd)
+                # down -> out after the grace window: clearing the
+                # in/out weight is what makes CRUSH remap the osd's
+                # positions so backfill can begin (the reference's
+                # mon_osd_down_out_interval flow)
+                for osd, since in list(self._down_since.items()):
+                    if self.map.is_up(osd):
+                        del self._down_since[osd]
+                    elif now - since > out_interval and \
+                            self.map.osd_weight[osd] > 0:
+                        to_out.append(osd)
+                        del self._down_since[osd]
+            for osd in stale:
+                self.log.dout(1, f"osd.{osd} heartbeat stale")
+                self.mark_down(osd)
+            for osd in to_out:
+                self.log.dout(1, f"osd.{osd} auto-out")
+                with self._lock:
+                    self.map.osd_weight[osd] = 0
+                self._commit(f"osd.{osd} auto-out")

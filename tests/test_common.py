@@ -1,0 +1,195 @@
+"""Foundation-layer tests: config layering/observers, subsystem log +
+crash ring, perf counters, admin socket round-trips, throttles —
+mirrors the reference's src/test/common coverage for the pieces the
+framework keeps (config.h layering, Log.cc dump_recent,
+perf_counters.h types, admin_socket.h command plane)."""
+
+import io
+import json
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.common.admin_socket import AdminSocket, wire_defaults
+from ceph_tpu.common.config import Config, Option
+from ceph_tpu.common.context import Context
+from ceph_tpu.common.log import LogCore, SubsysLogger
+from ceph_tpu.common.perf_counters import (PerfCounters,
+                                           PerfCountersCollection)
+from ceph_tpu.common.throttle import Throttle
+
+
+# -- config -----------------------------------------------------------------
+
+def test_config_layering(tmp_path, monkeypatch):
+    conf = Config()
+    assert conf["osd_pool_default_size"] == 3
+    assert conf.source_of("osd_pool_default_size") == "default"
+
+    f = tmp_path / "ceph.conf"
+    f.write_text("[global]\nosd pool default size = 5\n"
+                 "# comment\ndebug_crush = 10\n")
+    assert conf.load_file(str(f)) == 2
+    assert conf["osd_pool_default_size"] == 5
+    assert conf.source_of("osd_pool_default_size") == "file"
+
+    monkeypatch.setenv("CEPH_TPU_OPT_OSD_POOL_DEFAULT_SIZE", "7")
+    conf2 = Config()
+    conf2.load_file(str(f))
+    assert conf2["osd_pool_default_size"] == 7  # env beats file
+
+    conf2.set("osd_pool_default_size", 9)  # override beats env
+    assert conf2["osd_pool_default_size"] == 9
+    conf2.rm_override("osd_pool_default_size")
+    assert conf2["osd_pool_default_size"] == 7
+
+
+def test_config_json_file_and_bool_coercion(tmp_path):
+    conf = Config()
+    f = tmp_path / "conf.json"
+    f.write_text(json.dumps(
+        {"osd_calc_pg_upmaps_aggressively": "false"}))
+    conf.load_file(str(f))
+    assert conf["osd_calc_pg_upmaps_aggressively"] is False
+
+
+def test_config_observer_fires():
+    conf = Config()
+    seen = []
+    conf.add_observer("debug_crush",
+                      lambda name, v: seen.append((name, v)))
+    conf.set("debug_crush", 20)
+    assert seen == [("debug_crush", 20)]
+    with pytest.raises(KeyError):
+        conf.set("not_an_option", 1)
+    assert "value" in conf.show()["debug_crush"]
+
+
+# -- log --------------------------------------------------------------------
+
+def test_log_gating_and_ring():
+    sink = io.StringIO()
+    core = LogCore(max_recent=8, stream=sink)
+    log = SubsysLogger("crush", core)
+    core.set_level("crush", 5)
+    log.dout(1, "visible")
+    log.dout(10, "suppressed but ringed")
+    assert "visible" in sink.getvalue()
+    assert "suppressed" not in sink.getvalue()
+
+    dump = io.StringIO()
+    n = core.dump_recent(dump)
+    assert n == 2
+    assert "suppressed but ringed" in dump.getvalue()
+
+    for i in range(20):
+        log.dout(9, f"entry{i}")
+    dump2 = io.StringIO()
+    assert core.dump_recent(dump2) == 8  # ring bounded
+    assert "entry19" in dump2.getvalue()
+
+
+# -- perf counters ----------------------------------------------------------
+
+def test_perf_counter_types():
+    pc = PerfCounters("osd.0")
+    pc.add_u64_counter("ops")
+    pc.add_u64("queue_len")
+    pc.add_time("op_latency_total")
+    pc.add_u64_avg("op_latency")
+    pc.add_histogram("op_size", buckets=8)
+    pc.inc("ops")
+    pc.inc("ops", 2)
+    pc.set("queue_len", 5)
+    pc.dec("queue_len")
+    pc.tinc("op_latency_total", 0.5)
+    pc.avg_add("op_latency", 2.0)
+    pc.avg_add("op_latency", 4.0)
+    pc.hist_add("op_size", 100)
+    d = pc.dump()
+    assert d["ops"] == 3
+    assert d["queue_len"] == 4
+    assert d["op_latency_total"] == 0.5
+    assert d["op_latency"]["avg"] == 3.0
+    assert sum(d["op_size"]["buckets"]) == 1
+
+
+def test_perf_collection_dump():
+    col = PerfCountersCollection()
+    a = col.create("osd.0")
+    a.add_u64_counter("ops")
+    a.inc("ops")
+    b = col.create("osd.1")
+    b.add_u64_counter("ops")
+    full = col.dump()
+    assert full["osd.0"]["ops"] == 1 and full["osd.1"]["ops"] == 0
+    only = col.dump("osd.0")
+    assert list(only) == ["osd.0"]
+
+
+# -- admin socket -----------------------------------------------------------
+
+def test_admin_socket_round_trip(tmp_path):
+    path = str(tmp_path / "test.asok")
+    sock = AdminSocket(path)
+    conf = Config()
+    col = PerfCountersCollection()
+    pc = col.create("svc")
+    pc.add_u64_counter("reqs")
+    core = LogCore(stream=io.StringIO())
+    wire_defaults(sock, config=conf, perf=col, logcore=core)
+    sock.register("ping", lambda a: {"pong": a.get("x", 0)}, "ping")
+    sock.start()
+    try:
+        assert AdminSocket.request(path, "ping", x=7) == {"pong": 7}
+        pc.inc("reqs")
+        assert AdminSocket.request(path, "perf dump")["svc"]["reqs"] == 1
+        show = AdminSocket.request(path, "config show")
+        assert show["osd_pool_default_size"]["value"] == 3
+        AdminSocket.request(path, "config set",
+                            key="debug_crush", value=10)
+        assert AdminSocket.request(
+            path, "config get", key="debug_crush") == {"debug_crush": 10}
+        err = AdminSocket.request(path, "bogus")
+        assert "error" in err
+        helps = AdminSocket.request(path, "help")
+        assert "perf dump" in helps
+    finally:
+        sock.shutdown()
+
+
+def test_context_wires_everything(tmp_path):
+    ctx = Context("testd", admin_dir=str(tmp_path))
+    log = ctx.logger("crush")
+    ctx.conf.set("debug_crush", 7)  # observer drives the level live
+    assert ctx.log.get_level("crush") == 7
+    ctx.start_admin_socket()
+    try:
+        out = AdminSocket.request(ctx.admin_socket_path, "config get",
+                                  key="debug_crush")
+        assert out == {"debug_crush": 7}
+    finally:
+        ctx.shutdown()
+
+
+# -- throttle ---------------------------------------------------------------
+
+def test_throttle_blocks_and_releases():
+    th = Throttle("backfill", 2)
+    assert th.get_or_fail() and th.get_or_fail()
+    assert not th.get_or_fail()
+    assert not th.get(timeout=0.05)
+
+    done = []
+
+    def waiter():
+        done.append(th.get(timeout=2))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    th.put()
+    t.join()
+    assert done == [True]
+    assert th.get_current() == 2

@@ -1,0 +1,100 @@
+"""ObjectStore/MemStore tests — mirrors src/test/objectstore/store_test
+scenarios: transactional atomicity, extents/clone/omap semantics, and
+the checkpoint round-trip the OSD-analogue restart path uses."""
+
+import pytest
+
+from ceph_tpu.os.memstore import MemStore, TransactionError
+from ceph_tpu.os.objectstore import Transaction
+
+
+def make_store():
+    st = MemStore()
+    st.queue_transaction(Transaction().create_collection("pg1"))
+    return st
+
+
+def test_write_read_extents():
+    st = make_store()
+    st.queue_transaction(
+        Transaction().write("pg1", "obj", 0, b"hello")
+        .write("pg1", "obj", 10, b"world"))
+    assert st.read("pg1", "obj") == b"hello\0\0\0\0\0world"
+    assert st.read("pg1", "obj", 10, 5) == b"world"
+    assert st.stat("pg1", "obj")["size"] == 15
+
+
+def test_zero_truncate_remove():
+    st = make_store()
+    st.queue_transaction(Transaction().write("pg1", "o", 0, b"x" * 16))
+    st.queue_transaction(Transaction().zero("pg1", "o", 4, 8))
+    assert st.read("pg1", "o") == b"xxxx" + b"\0" * 8 + b"xxxx"
+    # zero past EOF extends (reference _zero-via-_write semantics)
+    st.queue_transaction(Transaction().zero("pg1", "o", 16, 8))
+    assert st.stat("pg1", "o")["size"] == 24
+    assert st.read("pg1", "o", 16) == b"\0" * 8
+    st.queue_transaction(Transaction().truncate("pg1", "o", 4))
+    assert st.read("pg1", "o") == b"xxxx"
+    st.queue_transaction(Transaction().truncate("pg1", "o", 8))
+    assert st.read("pg1", "o") == b"xxxx\0\0\0\0"
+    st.queue_transaction(Transaction().remove("pg1", "o"))
+    assert st.stat("pg1", "o") is None
+
+
+def test_clone_and_attrs_and_omap():
+    st = make_store()
+    st.queue_transaction(
+        Transaction().write("pg1", "src", 0, b"abc")
+        .setattr("pg1", "src", "version", b"7")
+        .omap_setkeys("pg1", "src", {"k1": b"v1", "k2": b"v2"}))
+    st.queue_transaction(Transaction().clone("pg1", "src", "dst"))
+    # clone is a snapshot: later writes to src don't leak into dst
+    st.queue_transaction(Transaction().write("pg1", "src", 0, b"zzz"))
+    assert st.read("pg1", "dst") == b"abc"
+    assert st.getattr("pg1", "dst", "version") == b"7"
+    assert st.omap_get("pg1", "dst") == {"k1": b"v1", "k2": b"v2"}
+    st.queue_transaction(
+        Transaction().omap_rmkeys("pg1", "dst", ["k1"]))
+    assert st.omap_get("pg1", "dst") == {"k2": b"v2"}
+
+
+def test_transaction_atomicity_on_failure():
+    """A failing op must leave the store untouched — the
+    queue_transaction contract."""
+    st = make_store()
+    st.queue_transaction(Transaction().write("pg1", "a", 0, b"keep"))
+    txn = (Transaction().write("pg1", "a", 0, b"clobbered")
+           .remove("pg1", "missing"))  # fails here
+    with pytest.raises(TransactionError):
+        st.queue_transaction(txn)
+    assert st.read("pg1", "a") == b"keep"  # first op rolled back
+
+
+def test_collection_lifecycle():
+    st = MemStore()
+    st.queue_transaction(Transaction().create_collection("c1"))
+    assert st.collection_exists("c1")
+    with pytest.raises(TransactionError):
+        st.queue_transaction(Transaction().create_collection("c1"))
+    st.queue_transaction(Transaction().touch("c1", "o"))
+    with pytest.raises(TransactionError):  # non-empty
+        st.queue_transaction(Transaction().remove_collection("c1"))
+    st.queue_transaction(
+        Transaction().remove("c1", "o").remove_collection("c1"))
+    assert not st.collection_exists("c1")
+    with pytest.raises(TransactionError):
+        st.queue_transaction(Transaction().touch("nope", "o"))
+
+
+def test_checkpoint_roundtrip():
+    st = make_store()
+    st.queue_transaction(
+        Transaction().write("pg1", "o", 0, bytes(range(256)))
+        .setattr("pg1", "o", "hinfo", b"\x01\x02")
+        .omap_setkeys("pg1", "o", {"epoch": b"5"}))
+    st2 = MemStore.import_state(st.export_state())
+    assert st2.read("pg1", "o") == bytes(range(256))
+    assert st2.getattr("pg1", "o", "hinfo") == b"\x01\x02"
+    assert st2.omap_get("pg1", "o") == {"epoch": b"5"}
+    assert st2.list_collections() == ["pg1"]
+    assert st2.list_objects("pg1") == ["o"]
