@@ -83,6 +83,21 @@ def worker_crush(batch=None, iters=None):
     d = json.load(open(REPO / "tests/golden/map_big10k.json"))
     cmap = CrushMap.from_dict(d["map"])
     case = d["cases"][0]
+
+    if not on_accel:
+        # the CPU engine of this framework is the native C++ batched
+        # mapper (XLA's while-loop lowering is not competitive on CPU);
+        # the accelerated path below is the TPU engine
+        try:
+            from ceph_tpu.crush.native import available
+
+            if available():
+                return _native_crush_rate(cmap, case, np)
+        except AssertionError:
+            raise  # golden mismatch = wrong mappings; never mask it
+        except Exception as e:
+            print(f"# native cpu engine unavailable: {e}",
+                  file=sys.stderr)
     t0 = time.perf_counter()
     fn, static, arrays = build_rule_fn(cmap, case["ruleno"], case["numrep"])
     A = jax.tree_util.tree_map(jnp.asarray, arrays)
@@ -112,8 +127,39 @@ def worker_crush(batch=None, iters=None):
     rate = batch * iters / measure_s
 
     print(RESULT_TAG + json.dumps({
-        "rate": rate, "platform": plat,
+        "rate": rate, "platform": plat, "engine": "xla",
         "compile_s": round(compile_s, 2),
+        "measure_s": round(measure_s, 3),
+        "batch": batch, "iters": iters,
+    }), flush=True)
+
+
+def _native_crush_rate(cmap, case, np):
+    from ceph_tpu.crush.native import NativeMapper
+
+    t0 = time.perf_counter()
+    nm = NativeMapper(cmap)
+    weight = np.asarray(case["weight"], np.uint32)
+    # golden validation first — the number must be a checked computation
+    n = case["x1"] - case["x0"]
+    res, lens = nm.map_batch(
+        case["ruleno"],
+        np.arange(case["x0"], case["x1"], dtype=np.uint32),
+        case["numrep"], weight)
+    for i in range(n):
+        assert list(res[i, :lens[i]]) == case["results"][i], \
+            f"golden mismatch at x={case['x0'] + i} on native"
+    setup_s = time.perf_counter() - t0
+
+    batch, iters = 1 << 16, 4
+    t0 = time.perf_counter()
+    for i in range(iters):
+        xs = np.arange(i * batch, (i + 1) * batch, dtype=np.uint32)
+        nm.map_batch(case["ruleno"], xs, case["numrep"], weight)
+    measure_s = time.perf_counter() - t0
+    print(RESULT_TAG + json.dumps({
+        "rate": batch * iters / measure_s, "platform": "cpu",
+        "engine": "native", "compile_s": round(setup_s, 2),
         "measure_s": round(measure_s, 3),
         "batch": batch, "iters": iters,
     }), flush=True)
@@ -259,9 +305,11 @@ def main():
         "unit": "mappings/s",
         "platform": headline["platform"],
         "vs_baseline": round(rate / CPU_BASELINE_MAPPINGS_PER_SEC, 2),
+        "engine": headline.get("engine"),
         "compile_s": headline.get("compile_s"),
         "measure_s": headline.get("measure_s"),
         "cpu_rate": round(cpu_res["rate"], 1) if cpu_res else None,
+        "cpu_engine": cpu_res.get("engine") if cpu_res else None,
     }
     print(json.dumps(out), flush=True)  # the ONE line — lands first
 

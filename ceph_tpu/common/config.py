@@ -149,6 +149,13 @@ class Config:
                      cb: Callable[[str, Any], None]) -> None:
         self._observers.setdefault(name, []).append(cb)
 
+    def remove_observer(self, name: str,
+                        cb: Callable[[str, Any], None]) -> None:
+        try:
+            self._observers.get(name, []).remove(cb)
+        except ValueError:
+            pass
+
     def source_of(self, name: str) -> str:
         if name in self._override:
             return "override"

@@ -28,15 +28,25 @@ class Context:
         self.perf = PerfCountersCollection()
         self._admin: Optional[AdminSocket] = None
         self._admin_dir = admin_dir
+        # (option, callback) pairs to detach on shutdown — contexts may
+        # share a Config (MiniCluster revives), so observers must not
+        # outlive their runtime
+        self._observers: list = []
+        self._observed: set = set()
 
     def logger(self, subsys: str) -> SubsysLogger:
         lg = SubsysLogger(subsys, self.log)
         # debug_<subsys> option drives the level, live (observer)
         opt = f"debug_{subsys}"
-        if opt in self.conf.schema:
+        if opt in self.conf.schema and opt not in self._observed:
             self.log.set_level(subsys, self.conf[opt])
-            self.conf.add_observer(
-                opt, lambda _n, v: self.log.set_level(subsys, int(v)))
+
+            def _cb(_n, v, _subsys=subsys):
+                self.log.set_level(_subsys, int(v))
+
+            self.conf.add_observer(opt, _cb)
+            self._observers.append((opt, _cb))
+            self._observed.add(opt)
         return lg
 
     @property
@@ -54,6 +64,10 @@ class Context:
         return self._admin
 
     def shutdown(self) -> None:
+        for opt, cb in self._observers:
+            self.conf.remove_observer(opt, cb)
+        self._observers.clear()
+        self._observed.clear()
         if self._admin is not None:
             self._admin.shutdown()
             self._admin = None

@@ -1,0 +1,150 @@
+"""ctypes bridge to the native host mapper (native/crush_host.cpp).
+
+The host-side hot loops (tools' scalar sweeps, the bench's CPU
+fallback) run the batched C++ mapper over the SAME SoA arrays the TPU
+mapper consumes; Python remains the source of truth (mapper_ref) and
+the graceful fallback when the library isn't built.
+
+``ensure_built()`` invokes the Makefile once per process if the .so is
+missing (the toolchain is part of the image); failures degrade to
+None — callers fall back to the Python/JAX paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+import subprocess
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .map import ChooseArgMap, CrushMap
+from .map_arrays import encode_map
+
+REPO = pathlib.Path(__file__).resolve().parents[2]
+NATIVE_DIR = REPO / "native"
+LIB_PATH = NATIVE_DIR / "libcrush_host.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+
+def ensure_built() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None on failure."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        # always run make: a no-op when fresh, and source edits never
+        # load a stale library (the Makefile carries the deps)
+        try:
+            subprocess.run(["make", "-s"], cwd=str(NATIVE_DIR),
+                           check=True, capture_output=True,
+                           timeout=120)
+        except Exception:
+            if not LIB_PATH.exists():
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(str(LIB_PATH))
+        except OSError:
+            _build_failed = True
+            return None
+        lib.crush_do_rule_batched.restype = ctypes.c_int
+        lib.crush_do_rule_batched.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int,
+            _i32p, _i32p, _i32p, _i32p, _i32p, _i32p,
+            _u32p, _u32p, _u32p, _u32p,
+            _i32p, _u32p, _u8p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, _i32p,
+            _u32p, ctypes.c_int,
+            ctypes.c_int, _u32p, ctypes.c_int,
+            _i32p, _i32p,
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return ensure_built() is not None
+
+
+class NativeMapper:
+    """Batched do_rule on the C++ engine for one (map, choose_args)."""
+
+    def __init__(self, cmap: CrushMap,
+                 choose_args: Optional[ChooseArgMap] = None):
+        lib = ensure_built()
+        if lib is None:
+            raise RuntimeError("native crush mapper unavailable")
+        self._lib = lib
+        self.cmap = cmap
+        self.static, arr = encode_map(cmap, choose_args)
+        self._a = {
+            "alg": np.ascontiguousarray(arr.alg, np.int32),
+            "btype": np.ascontiguousarray(arr.btype, np.int32),
+            "bhash": np.ascontiguousarray(arr.bhash, np.int32),
+            "size": np.ascontiguousarray(arr.size, np.int32),
+            "nnodes": np.ascontiguousarray(arr.nnodes, np.int32),
+            "items": np.ascontiguousarray(arr.items, np.int32),
+            "weights": np.ascontiguousarray(arr.weights, np.uint32),
+            "sum_weights": np.ascontiguousarray(arr.sum_weights,
+                                                np.uint32),
+            "straws": np.ascontiguousarray(arr.straws, np.uint32),
+            "node_weights": np.ascontiguousarray(arr.node_weights,
+                                                 np.uint32),
+            "arg_ids": np.ascontiguousarray(arr.arg_ids, np.int32),
+            "arg_weights": np.ascontiguousarray(arr.arg_weights,
+                                                np.uint32),
+            "has_arg": np.ascontiguousarray(
+                arr.has_arg.astype(np.uint8)),
+        }
+
+    def _steps(self, ruleno: int) -> np.ndarray:
+        rule = self.cmap.rules[ruleno]
+        return np.ascontiguousarray(
+            [[s.op, s.arg1, s.arg2] for s in rule.steps], np.int32)
+
+    def map_batch(self, ruleno: int, xs, result_max: int,
+                  weight) -> Tuple[np.ndarray, np.ndarray]:
+        """Same shape contract as BatchedMapper.map_batch."""
+        xs = np.ascontiguousarray(xs, np.uint32)
+        weight = np.ascontiguousarray(weight, np.uint32)
+        steps = self._steps(ruleno)
+        nx = len(xs)
+        results = np.zeros((nx, result_max), np.int32)
+        lens = np.zeros(nx, np.int32)
+        st = self.static
+        a = self._a
+        t = st.tunables
+        self._lib.crush_do_rule_batched(
+            st.max_buckets, st.max_size, st.max_nodes,
+            st.max_positions, st.max_devices,
+            a["alg"], a["btype"], a["bhash"], a["size"], a["nnodes"],
+            a["items"], a["weights"], a["sum_weights"], a["straws"],
+            a["node_weights"], a["arg_ids"], a["arg_weights"],
+            a["has_arg"],
+            t[0], t[1], t[2], t[3], t[4], t[5],
+            len(steps), steps,
+            weight, len(weight),
+            nx, xs, result_max,
+            results, lens)
+        return results, lens
+
+    def do_rule(self, ruleno: int, x: int, result_max: int,
+                weight) -> List[int]:
+        res, lens = self.map_batch(
+            ruleno, np.asarray([x], np.uint32), result_max, weight)
+        return list(res[0, :lens[0]])

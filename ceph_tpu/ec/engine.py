@@ -151,11 +151,23 @@ class BitCode:
         self._dec_cache: Dict[Tuple[int, ...], tuple] = {}
 
     # -- encode -------------------------------------------------------
+    def _fused_w8(self):
+        """The Pallas fused path applies on TPU for plain byte (w=8)
+        layouts — the bandwidth-bound RS/isa shape; None otherwise."""
+        if self.layout.is_packet or self.layout.w != 8:
+            return None
+        from . import pallas_kernels as PK
+
+        return PK if PK.on_tpu() else None
+
     def encode(self, data):
         """u8[k, L] -> parity u8[m, L]."""
         data = jnp.asarray(data)
         assert data.shape[0] == self.k
         self.layout.check(data.shape[1])
+        pk = self._fused_w8()
+        if pk is not None:
+            return pk.fused_gf2_matmul_w8(self._enc_dev, data)
         rows = self.layout.to_rows(data)
         out = _mod2_matmul(self._enc_dev, rows)
         return self.layout.from_rows(out, self.m, data.shape[1])
@@ -191,6 +203,9 @@ class BitCode:
         stack = jnp.stack([jnp.asarray(chunks[i]) for i in present])
         L = stack.shape[1]
         self.layout.check(L)
+        pk = self._fused_w8()
+        if pk is not None:
+            return pk.fused_gf2_matmul_w8(inv, stack)
         rows = self.layout.to_rows(stack)
         out = _mod2_matmul(inv, rows)
         return self.layout.from_rows(out, self.k, L)

@@ -22,10 +22,19 @@ from typing import Callable, Dict, Optional, Tuple
 Addr = Tuple[str, int]
 Handler = Callable[[Dict], Optional[Dict]]
 
+# per-socket send locks: sendall() on a large frame loops, so two
+# threads writing the same cached connection would interleave bytes
+# and corrupt the framing
+_send_locks: Dict[int, threading.Lock] = {}
+_send_locks_guard = threading.Lock()
+
 
 def _send_frame(sock: socket.socket, msg: Dict) -> None:
     body = json.dumps(msg).encode()
-    sock.sendall(struct.pack(">I", len(body)) + body)
+    with _send_locks_guard:
+        lock = _send_locks.setdefault(id(sock), threading.Lock())
+    with lock:
+        sock.sendall(struct.pack(">I", len(body)) + body)
 
 
 def _recv_frame(sock: socket.socket) -> Optional[Dict]:
@@ -94,11 +103,13 @@ class Messenger:
             while self._running:
                 try:
                     msg = _recv_frame(conn)
-                except OSError:
-                    break
+                except (OSError, ValueError):
+                    break  # closed or corrupt frame: drop the session
                 if msg is None:
                     break
                 self._dispatch(conn, msg)
+        with _send_locks_guard:
+            _send_locks.pop(id(conn), None)
 
     def _dispatch(self, conn: socket.socket, msg: Dict) -> None:
         type_ = msg.get("type", "")

@@ -52,17 +52,28 @@ class MemStore(ObjectStore):
     # -- transaction application --------------------------------------
     def queue_transaction(self, txn: Transaction) -> None:
         with self._lock:
-            staged = {cid: dict(objs)
-                      for cid, objs in self._coll.items()}
+            # lazy copy-on-touch: only the top-level dict is copied up
+            # front; a collection's object dict is copied the first
+            # time an op touches it (a shard write must not cost
+            # O(total objects across all PGs))
+            staged = dict(self._coll)
+            copied: set = set()
             for op in txn.ops:
-                self._apply(staged, op)
+                self._apply(staged, copied, op)
             self._coll = staged
 
-    def _obj(self, staged, cid: str, oid: str,
-             create: bool = False) -> _Object:
+    @staticmethod
+    def _coll_for_write(staged, copied, cid: str):
         if cid not in staged:
             raise TransactionError(f"no collection {cid!r}")
-        objs = staged[cid]
+        if cid not in copied:
+            staged[cid] = dict(staged[cid])
+            copied.add(cid)
+        return staged[cid]
+
+    def _obj(self, staged, copied, cid: str, oid: str,
+             create: bool = False) -> _Object:
+        objs = self._coll_for_write(staged, copied, cid)
         o = objs.get(oid)
         if o is None:
             if not create:
@@ -76,13 +87,14 @@ class MemStore(ObjectStore):
             objs[oid] = o
         return o
 
-    def _apply(self, staged, op) -> None:
+    def _apply(self, staged, copied, op) -> None:
         kind = op[0]
         if kind == OP_MKCOLL:
             _, cid = op
             if cid in staged:
                 raise TransactionError(f"collection {cid!r} exists")
             staged[cid] = {}
+            copied.add(cid)
         elif kind == OP_RMCOLL:
             _, cid = op
             if staged.get(cid):
@@ -92,10 +104,10 @@ class MemStore(ObjectStore):
             del staged[cid]
         elif kind == OP_TOUCH:
             _, cid, oid = op
-            self._obj(staged, cid, oid, create=True)
+            self._obj(staged, copied, cid, oid, create=True)
         elif kind == OP_WRITE:
             _, cid, oid, offset, data = op
-            o = self._obj(staged, cid, oid, create=True)
+            o = self._obj(staged, copied, cid, oid, create=True)
             end = offset + len(data)
             if len(o.data) < end:
                 o.data.extend(b"\0" * (end - len(o.data)))
@@ -103,14 +115,14 @@ class MemStore(ObjectStore):
         elif kind == OP_ZERO:
             _, cid, oid, offset, length = op
             # extends past EOF like the reference's _zero-via-_write
-            o = self._obj(staged, cid, oid)
+            o = self._obj(staged, copied, cid, oid)
             end = offset + length
             if len(o.data) < end:
                 o.data.extend(b"\0" * (end - len(o.data)))
             o.data[offset:end] = b"\0" * (end - offset)
         elif kind == OP_TRUNCATE:
             _, cid, oid, size = op
-            o = self._obj(staged, cid, oid)
+            o = self._obj(staged, copied, cid, oid)
             if len(o.data) > size:
                 del o.data[size:]
             else:
@@ -119,28 +131,28 @@ class MemStore(ObjectStore):
             _, cid, oid = op
             if cid not in staged or oid not in staged[cid]:
                 raise TransactionError(f"no object {cid}/{oid}")
-            del staged[cid][oid]
+            del self._coll_for_write(staged, copied, cid)[oid]
         elif kind == OP_CLONE:
             _, cid, src, dst = op
-            o = self._obj(staged, cid, src)
-            staged[cid][dst] = o.clone()
+            o = self._obj(staged, copied, cid, src)
+            self._coll_for_write(staged, copied, cid)[dst] = o.clone()
         elif kind == OP_SETATTR:
             _, cid, oid, key, value = op
-            self._obj(staged, cid, oid, create=True).xattr[key] = value
+            self._obj(staged, copied, cid, oid, create=True).xattr[key] = value
         elif kind == OP_RMATTR:
             _, cid, oid, key = op
-            self._obj(staged, cid, oid).xattr.pop(key, None)
+            self._obj(staged, copied, cid, oid).xattr.pop(key, None)
         elif kind == OP_OMAP_SETKEYS:
             _, cid, oid, kv = op
-            self._obj(staged, cid, oid, create=True).omap.update(kv)
+            self._obj(staged, copied, cid, oid, create=True).omap.update(kv)
         elif kind == OP_OMAP_RMKEYS:
             _, cid, oid, keys = op
-            o = self._obj(staged, cid, oid)
+            o = self._obj(staged, copied, cid, oid)
             for k in keys:
                 o.omap.pop(k, None)
         elif kind == OP_OMAP_CLEAR:
             _, cid, oid = op
-            self._obj(staged, cid, oid).omap.clear()
+            self._obj(staged, copied, cid, oid).omap.clear()
         else:
             raise TransactionError(f"unknown op {kind!r}")
 

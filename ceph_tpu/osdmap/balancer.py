@@ -354,6 +354,164 @@ def _search_overfull(m, wrapper, by_dev_desc, osd_deviation, osd_weight,
     return False
 
 
+# ---------------------------------------------------------------------------
+# crush-compat mode (balancer module.py do_crush_compat, :964-1120)
+# ---------------------------------------------------------------------------
+
+def distribution_score(m: OSDMap, osd_weight: Dict[int, float],
+                       only_pools: Optional[Set[int]] = None,
+                       pgs_by_osd: Optional[Dict[int, Set[PgId]]] = None
+                       ) -> float:
+    """Imbalance score in [0, 1), 0 = perfect (module.py:181-224
+    spirit: weight-share-weighted erf of relative deviation)."""
+    import math
+
+    if pgs_by_osd is None:
+        pgs_by_osd = build_pgs_by_osd(m, only_pools)
+    total = sum(len(p) for p in pgs_by_osd.values())
+    wsum = sum(osd_weight.values())
+    if not total or not wsum:
+        return 0.0
+    score = 0.0
+    for osd, share in osd_weight.items():
+        share /= wsum
+        if share <= 0:
+            continue
+        avg = total * share
+        actual = len(pgs_by_osd.get(osd, ()))
+        dev = abs(actual - avg) / avg if avg else 0.0
+        score += share * math.erf(dev / math.sqrt(2.0))
+    return score
+
+
+def weight_set_to_choose_args(wrapper: CrushWrapper,
+                              ws: Dict[int, float]):
+    """Lower per-device weight-set values (crush-weight units) to a
+    hierarchical choose_args set: every bucket's weight_set row is the
+    accumulated subtree value — the compat weight-set shape the
+    reference stores (CrushWrapper choose_args, crush.h:263-284)."""
+    from ..crush.map import ChooseArg, ChooseArgMap
+
+    def subtree(item: int) -> float:
+        if item >= 0:
+            return max(0.0, ws.get(item, 0.0))
+        return sum(subtree(c) for c in wrapper.get_bucket(item).items)
+
+    cam = ChooseArgMap()
+    for idx, b in wrapper.crush.buckets.items():
+        if b.id in wrapper._shadow_ids:
+            continue
+        row = [int(round(subtree(c) * 0x10000)) for c in b.items]
+        cam[idx] = ChooseArg(ids=None, weight_set=[row])
+    return cam
+
+
+def do_crush_compat(m: OSDMap,
+                    wrapper: Optional[CrushWrapper] = None,
+                    max_iterations: int = 25,
+                    step: float = 0.5,
+                    max_misplaced: float = 0.10,
+                    only_pools: Optional[Set[int]] = None,
+                    min_score: float = 0.0,
+                    seed: int = 0):
+    """The balancer's crush-compat mode: iteratively adjust a
+    choose_args weight set (NOT the real hierarchy weights) so actual
+    PG counts converge to crush-weight-proportional targets, accepting
+    steps that reduce the score within the misplacement budget.
+    Returns (score_before, score_after, choose_args) and installs the
+    winning set as ``m.crush.choose_args['compat']``."""
+    if wrapper is None:
+        wrapper = CrushWrapper(m.crush)
+    if not (0.0 < step < 1.0):
+        raise ValueError("step must be in (0, 1)")
+
+    # targets from the rule trees; weight shares per osd
+    osd_weight: Dict[int, float] = {}
+    total_pgs = 0
+    for pool_id, pool in m.pools.items():
+        if only_pools and pool_id not in only_pools:
+            continue
+        total_pgs += pool.size * pool.pg_num
+        for osd, share in get_rule_weight_osd_map(
+                wrapper, pool.crush_rule).items():
+            if osd < len(m.osd_weight) and m.osd_weight[osd] > 0:
+                osd_weight[osd] = osd_weight.get(osd, 0.0) + share
+    if not osd_weight or not total_pgs:
+        return 0.0, 0.0, None
+
+    def mapping_of(cam) -> Dict[int, Set[PgId]]:
+        saved = dict(m.crush.choose_args)
+        if cam is not None:
+            m.crush.choose_args["compat"] = cam
+            for pool_id in m.pools:
+                m.crush.choose_args.setdefault(
+                    pool_id, m.crush.choose_args["compat"])
+        try:
+            return build_pgs_by_osd(m, only_pools)
+        finally:
+            m.crush.choose_args = saved
+
+    base_map = mapping_of(None)
+    base_pairs = {(o, pg) for o, pgs in base_map.items() for pg in pgs}
+    score0 = distribution_score(m, osd_weight, only_pools, base_map)
+    if score0 <= min_score:
+        return score0, score0, None
+
+    wsum = sum(osd_weight.values())
+    # initial weight set = the real crush weights (compat semantics)
+    ws: Dict[int, float] = {}
+    for osd in osd_weight:
+        try:
+            ws[osd] = wrapper.get_item_weight(osd) / 0x10000
+        except KeyError:
+            ws[osd] = 1.0
+
+    best_ws = dict(ws)
+    best_map = base_map
+    best_score = score0
+    cur_step = step
+    for _ in range(max_iterations):
+        nxt = dict(best_ws)
+        actual_total = sum(len(p) for p in best_map.values())
+        total_ws = sum(nxt.values())
+        for osd, share in osd_weight.items():
+            target = actual_total * (share / wsum)
+            actual = len(best_map.get(osd, ()))
+            weight = nxt[osd]
+            if actual > 0:
+                calc = (target / actual) * weight
+            else:
+                # empty osd: aim at its fair share of the current
+                # weight-set mass (PG counts are not weight units)
+                calc = (share / wsum) * total_ws
+            nxt[osd] = weight * (1.0 - cur_step) + calc * cur_step
+        cam = weight_set_to_choose_args(wrapper, nxt)
+        new_map = mapping_of(cam)
+        new_pairs = {(o, pg) for o, pgs in new_map.items()
+                     for pg in pgs}
+        misplaced = (len(base_pairs - new_pairs)
+                     / max(1, len(base_pairs)))
+        new_score = distribution_score(m, osd_weight, only_pools,
+                                       new_map)
+        if misplaced > max_misplaced or new_score >= best_score:
+            cur_step /= 2.0
+            if cur_step < 0.01:
+                break
+            continue
+        best_ws, best_map, best_score = nxt, new_map, new_score
+        if best_score <= min_score:
+            break
+
+    if best_score >= score0:
+        return score0, score0, None
+    cam = weight_set_to_choose_args(wrapper, best_ws)
+    m.crush.choose_args["compat"] = cam
+    for pool_id in m.pools:
+        if not only_pools or pool_id in only_pools:
+            m.crush.choose_args[pool_id] = cam
+    return score0, best_score, cam
+
+
 def _search_underfull(m, by_dev_asc, osd_deviation, underfull,
                       max_deviation, to_skip, temp, to_unmap, to_upmap,
                       only_pools, aggressive, rng) -> bool:

@@ -104,7 +104,12 @@ class Client:
                 else:
                     n = code.get_chunk_count()
                     chunks = code.encode(range(n), data)
-                    if len(up) < n:
+                    # EC up sets are positional: down OSDs appear as
+                    # NONE holes, not a shorter list — any unreachable
+                    # position means the write must wait for remap
+                    if len(up) < n or any(
+                            o < 0 or o not in self.osd_addrs
+                            for o in up[:n]):
                         raise TimeoutError("degraded up set for write")
                     for pos in range(n):
                         self._write_shard(
@@ -113,7 +118,7 @@ class Client:
                                        np.uint8).tobytes(),
                             len(data))
                 return
-            except (TimeoutError, OSError):
+            except (TimeoutError, OSError, KeyError):
                 if attempt + 1 == retries:
                     raise
                 time.sleep(0.3)

@@ -93,6 +93,38 @@ class MiniCluster:
                      "pg_num": pg_num, "crush_rule": self.ec_rule,
                      "erasure_code_profile": profile_name}})
 
+    def scrub(self, pool_id: int) -> Dict[int, list]:
+        """Deep-scrub every PG of a pool on every up OSD; returns
+        {osd: [inconsistent shard names]} (non-empty = damage)."""
+        payload = self.mon.msgr.call(self.mon.addr,
+                                     {"type": "get_map"})
+        m = OSDMap.from_dict(payload["map"])
+        pool = m.pools[pool_id]
+        bad: Dict[int, list] = {}
+        for ps in range(pool.pg_num):
+            up, _p, _a, _ap = m.pg_to_up_acting_osds(pool_id, ps)
+            for osd in up:
+                svc = self.osds.get(osd)
+                if svc is None:
+                    continue
+                got = svc.msgr.call(svc.addr,
+                                    {"type": "pg_scrub",
+                                     "pool": pool_id, "ps": ps})
+                for name in got.get("inconsistent", []):
+                    bad.setdefault(osd, []).append(
+                        (pool_id, ps, name))
+        return bad
+
+    def repair(self, osd: int, pool_id: int, ps: int,
+               shard_name: str) -> None:
+        """Drop the damaged shard on ``osd``; recovery re-decodes it
+        from the survivors."""
+        oid, _, shard = shard_name.rpartition(".s")
+        svc = self.osds[osd]
+        svc.msgr.call(svc.addr, {"type": "shard_remove",
+                                 "pool": pool_id, "ps": ps,
+                                 "oid": oid, "shard": int(shard)})
+
     # -- thrasher hooks (qa/tasks/thrashosds.py role) -------------------
     def kill_osd(self, osd: int) -> None:
         svc = self.osds.pop(osd, None)

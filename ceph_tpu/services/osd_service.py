@@ -65,6 +65,8 @@ class OSDService:
         for t, h in (("shard_write", self._h_shard_write),
                      ("shard_read", self._h_shard_read),
                      ("pg_list", self._h_pg_list),
+                     ("pg_scrub", self._h_pg_scrub),
+                     ("shard_remove", self._h_shard_remove),
                      ("map_update", self._h_map_update),
                      ("status", self._h_status)):
             self.msgr.register(t, h)
@@ -107,7 +109,17 @@ class OSDService:
             self.osd_addrs = {int(k): tuple(v) for k, v in
                               payload.get("osd_addrs", {}).items()}
             self.ec_profiles = payload.get("ec_profiles", {})
+            wrongly_down = self._running and \
+                not self.map.is_up(self.id)
         self.pc.inc("map_epochs")
+        if wrongly_down:
+            # we observed our own markdown but we're alive: re-boot to
+            # the mon (the reference OSD's "map says I'm down" flow)
+            self.log.dout(1, f"osd.{self.id} marked down in epoch "
+                             f"{payload['epoch']}; re-booting to mon")
+            self.msgr.send(self.mon_addr,
+                           {"type": "boot", "osd": self.id,
+                            "addr": list(self.addr)})
         self._recover_wake.set()
 
     def _h_map_update(self, msg: Dict) -> None:
@@ -126,6 +138,8 @@ class OSDService:
 
     # -- op handlers (the ECBackend sub-op surface) --------------------
     def _h_shard_write(self, msg: Dict) -> Dict:
+        from ..ec.stripe import crc32c
+
         cid = pg_cid(msg["pool"], msg["ps"])
         oid = f"{msg['oid']}.s{msg['shard']}"
         txn = Transaction()
@@ -134,6 +148,7 @@ class OSDService:
         data = bytes.fromhex(msg["data"])
         txn.write(cid, oid, 0, data)
         txn.setattr(cid, oid, "size", str(msg["size"]).encode())
+        txn.setattr(cid, oid, "crc", str(crc32c(data)).encode())
         seq = str(time.time_ns())
         txn.omap_setkeys(cid, "pglog", {
             seq: f'{{"op":"write","oid":"{msg["oid"]}",'
@@ -164,6 +179,39 @@ class OSDService:
             size = self.store.getattr(cid, name, "size") or b"0"
             out[oid] = int(size)
         return {"objects": out}
+
+    def _h_pg_scrub(self, msg: Dict) -> Dict:
+        """Deep scrub of one PG: recompute every local shard's crc32c
+        and compare with the stored write-time digest (the
+        HashInfo-backed scrub of the reference's deep-scrub flow)."""
+        from ..ec.stripe import crc32c
+
+        cid = pg_cid(msg["pool"], msg["ps"])
+        inconsistent: List[str] = []
+        digests: Dict[str, int] = {}
+        if self.store.collection_exists(cid):
+            for name in self.store.list_objects(cid):
+                if name == "pglog":
+                    continue
+                data = self.store.read(cid, name)
+                got = crc32c(data)
+                stored = self.store.getattr(cid, name, "crc")
+                digests[name] = got
+                if stored is not None and int(stored) != got:
+                    inconsistent.append(name)
+        return {"osd": self.id, "inconsistent": inconsistent,
+                "digests": digests}
+
+    def _h_shard_remove(self, msg: Dict) -> Dict:
+        """Drop a (corrupt) shard so recovery rebuilds it — the repair
+        half of scrub (test-erasure-eio.sh flow)."""
+        cid = pg_cid(msg["pool"], msg["ps"])
+        name = f"{msg['oid']}.s{msg['shard']}"
+        if self.store.stat(cid, name) is not None:
+            self.store.queue_transaction(
+                Transaction().remove(cid, name))
+        self._recover_wake.set()
+        return {"ok": True}
 
     def _h_status(self, _msg: Dict) -> Dict:
         with self._lock:

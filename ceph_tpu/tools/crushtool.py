@@ -145,6 +145,9 @@ def main(argv=None) -> int:
     p.add_argument("--scalar", action="store_true",
                    help="use the scalar spec instead of the batched "
                         "mapper (tiny runs; no compile cost)")
+    p.add_argument("--native", action="store_true",
+                   help="use the native C++ host mapper (fast CPU "
+                        "sweeps; builds on first use)")
     args = p.parse_args(argv)
 
     if args.compilefn:
@@ -220,6 +223,7 @@ def main(argv=None) -> int:
                 rep = tester.test_rule(
                     rno, nrep, args.min_x, args.max_x,
                     pool=args.pool, scalar=args.scalar,
+                    native=args.native,
                     collect_mappings=args.show_mappings)
                 print(format_report(
                     rep, w,

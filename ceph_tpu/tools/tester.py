@@ -61,7 +61,7 @@ class CrushTester:
     # -- the sweep -----------------------------------------------------
     def test_rule(self, ruleno: int, num_rep: int, min_x: int = 0,
                   max_x: int = 1023, pool: Optional[int] = None,
-                  scalar: bool = False,
+                  scalar: bool = False, native: bool = False,
                   collect_mappings: bool = False) -> RuleReport:
         cmap = self.w.crush
         xs = np.arange(min_x, max_x + 1, dtype=np.uint32)
@@ -72,6 +72,15 @@ class CrushTester:
             results = [crush_do_rule(cmap, ruleno, int(x), num_rep,
                                      self.weights) for x in xs]
             lens = [len(r) for r in results]
+        elif native:
+            from ..crush.native import NativeMapper
+
+            nm = NativeMapper(cmap)
+            res, ln = nm.map_batch(
+                ruleno, xs, num_rep,
+                np.asarray(self.weights, np.uint32))
+            results = [list(res[i, :ln[i]]) for i in range(len(xs))]
+            lens = list(ln)
         else:
             from ..crush.mapper_jax import BatchedMapper
 

@@ -1,0 +1,623 @@
+// crush_host — the native batched host mapper.
+//
+// The framework's hot host-side loop (tools' scalar sweeps, the bench's
+// CPU fallback, balancer candidate evaluation) implemented in C++
+// against the SAME flat SoA map encoding the TPU mapper consumes
+// (ceph_tpu/crush/map_arrays.py) — not the reference's pointer-forest
+// bucket structs.  Semantics are a re-derivation of this repo's own
+// executable specification (ceph_tpu/crush/mapper_ref.py, itself
+// golden-tested against the reference C core): rjenkins mix draws,
+// fixed-point straw2 via the shared ln LUT, all five bucket algorithms,
+// firstn retry descent and positionally-stable indep, the full rule VM
+// with tunables.  Built as a shared library; loaded via ctypes
+// (ceph_tpu/crush/native.py) with a pure-Python fallback when absent.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include "crush_ln_tables.h"
+
+namespace {
+
+constexpr uint32_t kHashSeed = 0x4E67C6A7u;  // 1315423911
+constexpr int32_t kItemUndef = 0x7FFFFFFE;
+constexpr int32_t kItemNone = 0x7FFFFFFF;
+constexpr int64_t kS64Min = INT64_MIN;
+
+constexpr int kAlgUniform = 1;
+constexpr int kAlgList = 2;
+constexpr int kAlgTree = 3;
+constexpr int kAlgStraw = 4;
+constexpr int kAlgStraw2 = 5;
+
+constexpr int kOpTake = 1;
+constexpr int kOpChooseFirstn = 2;
+constexpr int kOpChooseIndep = 3;
+constexpr int kOpEmit = 4;
+constexpr int kOpChooseleafFirstn = 6;
+constexpr int kOpChooseleafIndep = 7;
+constexpr int kOpSetChooseTries = 8;
+constexpr int kOpSetChooseleafTries = 9;
+constexpr int kOpSetChooseLocalTries = 10;
+constexpr int kOpSetChooseLocalFallbackTries = 11;
+constexpr int kOpSetChooseleafVaryR = 12;
+constexpr int kOpSetChooseleafStable = 13;
+
+// ---- rjenkins mix (the one every draw goes through) -----------------------
+
+inline void mix(uint32_t& a, uint32_t& b, uint32_t& c) {
+  a = a - b - c; a ^= c >> 13;
+  b = b - c - a; b ^= a << 8;
+  c = c - a - b; c ^= b >> 13;
+  a = a - b - c; a ^= c >> 12;
+  b = b - c - a; b ^= a << 16;
+  c = c - a - b; c ^= b >> 5;
+  a = a - b - c; a ^= c >> 3;
+  b = b - c - a; b ^= a << 10;
+  c = c - a - b; c ^= b >> 15;
+}
+
+inline uint32_t hash2(uint32_t a, uint32_t b) {
+  uint32_t h = kHashSeed ^ a ^ b;
+  uint32_t x = 231232, y = 1232;
+  mix(a, b, h);
+  mix(x, a, h);
+  mix(b, y, h);
+  return h;
+}
+
+inline uint32_t hash3(uint32_t a, uint32_t b, uint32_t c) {
+  uint32_t h = kHashSeed ^ a ^ b ^ c;
+  uint32_t x = 231232, y = 1232;
+  mix(a, b, h);
+  mix(c, x, h);
+  mix(y, a, h);
+  mix(b, x, h);
+  mix(y, c, h);
+  return h;
+}
+
+inline uint32_t hash4(uint32_t a, uint32_t b, uint32_t c, uint32_t d) {
+  uint32_t h = kHashSeed ^ a ^ b ^ c ^ d;
+  uint32_t x = 231232, y = 1232;
+  mix(a, b, h);
+  mix(c, d, h);
+  mix(a, x, h);
+  mix(y, b, h);
+  mix(c, x, h);
+  mix(y, d, h);
+  return h;
+}
+
+// ---- fixed-point 2^44*log2 via the shared LUT -----------------------------
+
+inline uint64_t crush_ln(uint32_t xin) {
+  uint32_t x = xin + 1;
+  int iexpon = 15;
+  if (!(x & 0x18000)) {
+    int bits = 0;
+    uint32_t v = x & 0x1FFFF;
+    while (v) { bits++; v >>= 1; }
+    bits = 16 - bits;
+    x <<= bits;
+    iexpon = 15 - bits;
+  }
+  uint32_t index1 = (x >> 8) << 1;
+  uint64_t rh = CRUSH_RH_LH_TBL[index1 - 256];
+  uint64_t lh = CRUSH_RH_LH_TBL[index1 + 1 - 256];
+  uint64_t xl64 = ((uint64_t)x * rh) >> 48;
+  uint32_t index2 = xl64 & 0xFF;
+  lh = (lh + CRUSH_LL_TBL[index2]) >> (48 - 12 - 32);
+  return ((uint64_t)iexpon << (12 + 32)) + lh;
+}
+
+inline int64_t straw2_draw(uint32_t x, int32_t item_id, uint32_t r,
+                           uint32_t weight) {
+  if (weight == 0) return kS64Min;
+  uint32_t u = hash3(x, (uint32_t)item_id, r) & 0xFFFF;
+  int64_t ln = (int64_t)crush_ln(u) - 0x1000000000000LL;
+  // truncation toward zero on a negative numerator: native C++ division
+  return ln / (int64_t)weight;
+}
+
+// ---- the SoA map view -----------------------------------------------------
+
+struct MapView {
+  int B, S, N, P, max_devices;
+  const int32_t *alg, *btype, *bhash, *size, *nnodes;
+  const int32_t *items;         // [B,S]
+  const uint32_t *weights;      // [B,S]
+  const uint32_t *sum_weights;  // [B,S]
+  const uint32_t *straws;       // [B,S]
+  const uint32_t *node_weights; // [B,N]
+  const int32_t *arg_ids;       // [B,S]
+  const uint32_t *arg_weights;  // [B,P,S]
+  const uint8_t *has_arg;       // [B]
+
+  bool valid_bucket(int32_t id) const {
+    int idx = -1 - id;
+    return id < 0 && idx < B && alg[idx] != 0;
+  }
+  int idx(int32_t id) const { return -1 - id; }
+};
+
+struct Tunables {
+  int local_tries, local_fallback_tries, total_tries, descend_once,
+      vary_r, stable;
+};
+
+// per-x workspace: uniform-bucket permutation state
+struct PermState {
+  uint32_t perm_x = 0;
+  uint32_t perm_n = 0;
+  std::vector<int> perm;
+};
+
+struct Workspace {
+  std::vector<PermState> perm;  // indexed by bucket index
+  explicit Workspace(int B) : perm(B) {}
+};
+
+// ---- bucket choose methods ------------------------------------------------
+
+int bucket_perm_choose(const MapView& m, int bi, PermState& ws,
+                       uint32_t x, uint32_t r) {
+  int size = m.size[bi];
+  int32_t id = -1 - bi;
+  uint32_t pr = r % size;
+  if (ws.perm.empty()) {
+    ws.perm.resize(m.S);
+    for (int i = 0; i < m.S; i++) ws.perm[i] = i;
+  }
+  if (ws.perm_x != x || ws.perm_n == 0) {
+    ws.perm_x = x;
+    if (pr == 0) {
+      int s = hash3(x, (uint32_t)id, 0) % size;
+      ws.perm[0] = s;
+      ws.perm_n = 0xFFFF;
+      return m.items[bi * m.S + s];
+    }
+    for (int i = 0; i < size; i++) ws.perm[i] = i;
+    ws.perm_n = 0;
+  } else if (ws.perm_n == 0xFFFF) {
+    for (int i = 1; i < size; i++) ws.perm[i] = i;
+    ws.perm[ws.perm[0]] = 0;
+    ws.perm_n = 1;
+  }
+  while (ws.perm_n <= pr) {
+    unsigned p = ws.perm_n;
+    if ((int)p < size - 1) {
+      unsigned i = hash3(x, (uint32_t)id, p) % (size - p);
+      if (i) {
+        int t = ws.perm[p + i];
+        ws.perm[p + i] = ws.perm[p];
+        ws.perm[p] = t;
+      }
+    }
+    ws.perm_n++;
+  }
+  return m.items[bi * m.S + ws.perm[pr]];
+}
+
+int bucket_list_choose(const MapView& m, int bi, uint32_t x, uint32_t r) {
+  int32_t id = -1 - bi;
+  for (int i = m.size[bi] - 1; i >= 0; i--) {
+    uint64_t w = hash4(x, (uint32_t)m.items[bi * m.S + i], r,
+                       (uint32_t)id) & 0xFFFF;
+    w = (w * m.sum_weights[bi * m.S + i]) >> 16;
+    if (w < m.weights[bi * m.S + i]) return m.items[bi * m.S + i];
+  }
+  return m.items[bi * m.S + 0];
+}
+
+int bucket_tree_choose(const MapView& m, int bi, uint32_t x, uint32_t r) {
+  int32_t id = -1 - bi;
+  int n = m.nnodes[bi] >> 1;
+  while (!(n & 1)) {
+    uint32_t w = m.node_weights[bi * m.N + n];
+    uint64_t t = (uint64_t)hash4(x, (uint32_t)n, r, (uint32_t)id) * w;
+    t >>= 32;
+    int h = 0, nn = n;
+    while ((nn & 1) == 0) { h++; nn >>= 1; }
+    int left = n - (1 << (h - 1));
+    n = (t < m.node_weights[bi * m.N + left]) ? left
+                                              : n + (1 << (h - 1));
+  }
+  return m.items[bi * m.S + (n >> 1)];
+}
+
+int bucket_straw_choose(const MapView& m, int bi, uint32_t x, uint32_t r) {
+  int high = 0;
+  uint64_t high_draw = 0;
+  for (int i = 0; i < m.size[bi]; i++) {
+    uint64_t draw = (uint64_t)(hash3(x,
+        (uint32_t)m.items[bi * m.S + i], r) & 0xFFFF)
+        * m.straws[bi * m.S + i];
+    if (i == 0 || draw > high_draw) {
+      high = i;
+      high_draw = draw;
+    }
+  }
+  return m.items[bi * m.S + high];
+}
+
+int bucket_straw2_choose(const MapView& m, int bi, uint32_t x, uint32_t r,
+                         int position) {
+  const int32_t* ids = m.items + bi * m.S;
+  const uint32_t* ws = m.weights + bi * m.S;
+  if (m.has_arg[bi]) {
+    ids = m.arg_ids + bi * m.S;
+    int pos = position < m.P ? position : m.P - 1;
+    ws = m.arg_weights + ((size_t)bi * m.P + pos) * m.S;
+  }
+  int high = 0;
+  int64_t high_draw = 0;
+  for (int i = 0; i < m.size[bi]; i++) {
+    int64_t draw = straw2_draw(x, ids[i], r, ws[i]);
+    if (i == 0 || draw > high_draw) {
+      high = i;
+      high_draw = draw;
+    }
+  }
+  return m.items[bi * m.S + high];
+}
+
+int bucket_choose(const MapView& m, Workspace& work, int bi, uint32_t x,
+                  uint32_t r, int position) {
+  switch (m.alg[bi]) {
+    case kAlgUniform:
+      return bucket_perm_choose(m, bi, work.perm[bi], x, r);
+    case kAlgList:
+      return bucket_list_choose(m, bi, x, r);
+    case kAlgTree:
+      return bucket_tree_choose(m, bi, x, r);
+    case kAlgStraw:
+      return bucket_straw_choose(m, bi, x, r);
+    case kAlgStraw2:
+      return bucket_straw2_choose(m, bi, x, r, position);
+    default:
+      return m.items[bi * m.S + 0];
+  }
+}
+
+inline bool is_out(const uint32_t* weight, int weight_len, int item,
+                   uint32_t x) {
+  if (item >= weight_len) return true;
+  uint32_t w = weight[item];
+  if (w >= 0x10000) return false;
+  if (w == 0) return true;
+  return (hash2(x, (uint32_t)item) & 0xFFFF) >= w;
+}
+
+// ---- firstn retry descent -------------------------------------------------
+
+int choose_firstn(const MapView& m, const Tunables& t, Workspace& work,
+                  int bucket_bi, const uint32_t* weight, int weight_len,
+                  uint32_t x, int numrep, int type, int32_t* out, int outpos,
+                  int out_size, int tries, int recurse_tries,
+                  int local_retries, int local_fallback_retries,
+                  bool recurse_to_leaf, int vary_r, int stable,
+                  int32_t* out2, int parent_r) {
+  int count = out_size;
+  int rep = stable ? 0 : outpos;
+  while (rep < numrep && count > 0) {
+    int ftotal = 0;
+    bool skip_rep = false;
+    int item = 0;
+    bool retry_descent = true;
+    while (retry_descent) {
+      retry_descent = false;
+      int in_bi = bucket_bi;
+      int flocal = 0;
+      bool retry_bucket = true;
+      while (retry_bucket) {
+        retry_bucket = false;
+        bool collide = false, reject = false;
+        uint32_t r = rep + parent_r + ftotal;
+        if (m.size[in_bi] == 0) {
+          reject = true;
+        } else {
+          if (local_fallback_retries > 0 &&
+              flocal >= (m.size[in_bi] >> 1) &&
+              flocal > local_fallback_retries) {
+            item = bucket_perm_choose(m, in_bi, work.perm[in_bi], x, r);
+          } else {
+            item = bucket_choose(m, work, in_bi, x, r, outpos);
+          }
+          if (item >= m.max_devices) {
+            skip_rep = true;
+            break;
+          }
+          int itemtype = -1;  // "no such bucket" sentinel
+          if (item < 0) {
+            if (m.valid_bucket(item)) itemtype = m.btype[m.idx(item)];
+          } else {
+            itemtype = 0;
+          }
+          if (itemtype != type) {
+            if (item >= 0 || !m.valid_bucket(item)) {
+              skip_rep = true;
+              break;
+            }
+            in_bi = m.idx(item);
+            retry_bucket = true;
+            continue;
+          }
+          for (int i = 0; i < outpos; i++) {
+            if (out[i] == item) {
+              collide = true;
+              break;
+            }
+          }
+          if (!collide && recurse_to_leaf) {
+            if (item < 0) {
+              int sub_r = vary_r ? ((int)r >> (vary_r - 1)) : 0;
+              int got = choose_firstn(
+                  m, t, work, m.idx(item), weight, weight_len, x,
+                  stable ? 1 : outpos + 1, 0, out2, outpos, count,
+                  recurse_tries, 0, local_retries,
+                  local_fallback_retries, false, vary_r, stable,
+                  nullptr, sub_r);
+              if (got <= outpos) reject = true;
+            } else {
+              out2[outpos] = item;
+            }
+          }
+          if (!reject && !collide && itemtype == 0) {
+            reject = is_out(weight, weight_len, item, x);
+          }
+        }
+        if (reject || collide) {
+          ftotal++;
+          flocal++;
+          if (collide && flocal <= local_retries) {
+            retry_bucket = true;
+          } else if (local_fallback_retries > 0 &&
+                     flocal <= m.size[in_bi] + local_fallback_retries) {
+            retry_bucket = true;
+          } else if (ftotal < tries) {
+            retry_descent = true;
+            break;
+          } else {
+            skip_rep = true;
+          }
+        }
+      }
+    }
+    if (!skip_rep) {
+      out[outpos] = item;
+      outpos++;
+      count--;
+    }
+    rep++;
+  }
+  return outpos;
+}
+
+// ---- indep breadth-first variant ------------------------------------------
+
+void choose_indep(const MapView& m, const Tunables& t, Workspace& work,
+                  int bucket_bi, const uint32_t* weight, int weight_len,
+                  uint32_t x, int left, int numrep, int type, int32_t* out,
+                  int outpos, int tries, int recurse_tries,
+                  bool recurse_to_leaf, int32_t* out2, int parent_r) {
+  int endpos = outpos + left;
+  for (int rep = outpos; rep < endpos; rep++) {
+    out[rep] = kItemUndef;
+    if (out2) out2[rep] = kItemUndef;
+  }
+  int ftotal = 0;
+  while (left > 0 && ftotal < tries) {
+    for (int rep = outpos; rep < endpos; rep++) {
+      if (out[rep] != kItemUndef) continue;
+      int in_bi = bucket_bi;
+      for (;;) {
+        uint32_t r = rep + parent_r;
+        if (m.alg[in_bi] == kAlgUniform && m.size[in_bi] % numrep == 0) {
+          r += (numrep + 1) * ftotal;
+        } else {
+          r += numrep * ftotal;
+        }
+        if (m.size[in_bi] == 0) break;
+        int item = bucket_choose(m, work, in_bi, x, r, outpos);
+        if (item >= m.max_devices) {
+          out[rep] = kItemNone;
+          if (out2) out2[rep] = kItemNone;
+          left--;
+          break;
+        }
+        int itemtype = -1;
+        if (item < 0) {
+          if (m.valid_bucket(item)) itemtype = m.btype[m.idx(item)];
+        } else {
+          itemtype = 0;
+        }
+        if (itemtype != type) {
+          if (item >= 0 || !m.valid_bucket(item)) {
+            out[rep] = kItemNone;
+            if (out2) out2[rep] = kItemNone;
+            left--;
+            break;
+          }
+          in_bi = m.idx(item);
+          continue;
+        }
+        bool collide = false;
+        for (int i = outpos; i < endpos; i++) {
+          if (out[i] == item) {
+            collide = true;
+            break;
+          }
+        }
+        if (collide) break;
+        if (recurse_to_leaf) {
+          if (item < 0) {
+            choose_indep(m, t, work, m.idx(item), weight, weight_len, x,
+                         1, numrep, 0, out2, rep, recurse_tries, 0,
+                         false, nullptr, r);
+            if (out2 && out2[rep] == kItemNone) break;
+          } else if (out2) {
+            out2[rep] = item;
+          }
+        }
+        if (itemtype == 0 && is_out(weight, weight_len, item, x)) break;
+        out[rep] = item;
+        left--;
+        break;
+      }
+    }
+    ftotal++;
+  }
+  for (int rep = outpos; rep < endpos; rep++) {
+    if (out[rep] == kItemUndef) out[rep] = kItemNone;
+    if (out2 && out2[rep] == kItemUndef) out2[rep] = kItemNone;
+  }
+}
+
+// ---- the rule VM ----------------------------------------------------------
+
+int do_rule_one(const MapView& m, const Tunables& tun, int nsteps,
+                const int32_t* steps, const uint32_t* weight,
+                int weight_len, uint32_t x, int result_max,
+                int32_t* result) {
+  std::vector<int32_t> wv(result_max), ov(result_max), cv(result_max);
+  int32_t* w = wv.data();
+  int32_t* o = ov.data();
+  int32_t* c = cv.data();
+  int wsize = 0;
+  int result_len = 0;
+
+  int choose_tries = tun.total_tries + 1;  // off-by-one heritage
+  int choose_leaf_tries = 0;
+  int local_retries = tun.local_tries;
+  int local_fallback_retries = tun.local_fallback_tries;
+  int vary_r = tun.vary_r;
+  int stable = tun.stable;
+
+  Workspace work(m.B);
+
+  for (int s = 0; s < nsteps; s++) {
+    int op = steps[s * 3], arg1 = steps[s * 3 + 1],
+        arg2 = steps[s * 3 + 2];
+    switch (op) {
+      case kOpTake:
+        if ((arg1 >= 0 && arg1 < m.max_devices) || m.valid_bucket(arg1)) {
+          w[0] = arg1;
+          wsize = 1;
+        }
+        break;
+      case kOpSetChooseTries:
+        if (arg1 > 0) choose_tries = arg1;
+        break;
+      case kOpSetChooseleafTries:
+        if (arg1 > 0) choose_leaf_tries = arg1;
+        break;
+      case kOpSetChooseLocalTries:
+        if (arg1 >= 0) local_retries = arg1;
+        break;
+      case kOpSetChooseLocalFallbackTries:
+        if (arg1 >= 0) local_fallback_retries = arg1;
+        break;
+      case kOpSetChooseleafVaryR:
+        if (arg1 >= 0) vary_r = arg1;
+        break;
+      case kOpSetChooseleafStable:
+        if (arg1 >= 0) stable = arg1;
+        break;
+      case kOpChooseFirstn:
+      case kOpChooseIndep:
+      case kOpChooseleafFirstn:
+      case kOpChooseleafIndep: {
+        if (wsize == 0) break;
+        bool firstn =
+            (op == kOpChooseFirstn || op == kOpChooseleafFirstn);
+        bool to_leaf =
+            (op == kOpChooseleafFirstn || op == kOpChooseleafIndep);
+        int osize = 0;
+        for (int i = 0; i < wsize; i++) {
+          int numrep = arg1;
+          if (numrep <= 0) {
+            numrep += result_max;
+            if (numrep <= 0) continue;
+          }
+          if (w[i] >= 0 || !m.valid_bucket(w[i])) continue;
+          int bi = m.idx(w[i]);
+          if (firstn) {
+            int recurse_tries =
+                choose_leaf_tries ? choose_leaf_tries
+                                  : (tun.descend_once ? 1 : choose_tries);
+            osize += choose_firstn(
+                m, tun, work, bi, weight, weight_len, x, numrep, arg2,
+                o + osize, 0, result_max - osize, choose_tries,
+                recurse_tries, local_retries, local_fallback_retries,
+                to_leaf, vary_r, stable, c + osize, 0);
+          } else {
+            int out_size =
+                numrep < result_max - osize ? numrep : result_max - osize;
+            choose_indep(m, tun, work, bi, weight, weight_len, x,
+                         out_size, numrep, arg2, o + osize, 0,
+                         choose_tries,
+                         choose_leaf_tries ? choose_leaf_tries : 1,
+                         to_leaf, c + osize, 0);
+            osize += out_size;
+          }
+        }
+        if (to_leaf) memcpy(o, c, osize * sizeof(int32_t));
+        int32_t* tmp = w;
+        w = o;
+        o = tmp;
+        wsize = osize;
+        break;
+      }
+      case kOpEmit:
+        for (int i = 0; i < wsize && result_len < result_max; i++) {
+          result[result_len++] = w[i];
+        }
+        wsize = 0;
+        break;
+      default:
+        break;
+    }
+  }
+  return result_len;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Map every x in the batch.  Arrays follow the MapArrays layout.
+// results: [nx, result_max]; result_lens: [nx].  Returns 0.
+int crush_do_rule_batched(
+    int B, int S, int N, int P, int max_devices,
+    const int32_t* alg, const int32_t* btype, const int32_t* bhash,
+    const int32_t* size, const int32_t* nnodes, const int32_t* items,
+    const uint32_t* weights, const uint32_t* sum_weights,
+    const uint32_t* straws, const uint32_t* node_weights,
+    const int32_t* arg_ids, const uint32_t* arg_weights,
+    const uint8_t* has_arg,
+    int choose_local_tries, int choose_local_fallback_tries,
+    int choose_total_tries, int chooseleaf_descend_once,
+    int chooseleaf_vary_r, int chooseleaf_stable,
+    int nsteps, const int32_t* steps,
+    const uint32_t* weight, int weight_len,
+    int nx, const uint32_t* xs, int result_max,
+    int32_t* results, int32_t* result_lens) {
+  MapView m{B, S, N, P, max_devices, alg, btype, bhash, size, nnodes,
+            items, weights, sum_weights, straws, node_weights, arg_ids,
+            arg_weights, has_arg};
+  Tunables t{choose_local_tries, choose_local_fallback_tries,
+             choose_total_tries, chooseleaf_descend_once,
+             chooseleaf_vary_r, chooseleaf_stable};
+  // each x owns its workspace and output row: embarrassingly parallel
+#pragma omp parallel for schedule(dynamic, 256)
+  for (int i = 0; i < nx; i++) {
+    result_lens[i] = do_rule_one(m, t, nsteps, steps, weight, weight_len,
+                                 xs[i], result_max,
+                                 results + (size_t)i * result_max);
+  }
+  return 0;
+}
+
+}  // extern "C"
