@@ -1,0 +1,76 @@
+"""Versioned encode/decode envelopes — the denc/encoding.h seam.
+
+The reference wraps every wire/disk structure in
+``ENCODE_START(v, compat_v)`` / ``ENCODE_FINISH`` (src/include/
+encoding.h:1531, denc.h): a version byte, a compat floor, and a length
+guard, so old daemons can skip fields they don't know and refuse
+structures newer than they can safely read.  This framework's wire
+format is JSON; the envelope carries the same three facts:
+
+    {"v": <struct version>, "compat": <oldest reader that may decode>,
+     "data": {...}}
+
+``decode`` raises on ``compat`` above the reader's supported version
+(the reference's buffer::malformed_input behavior) and delivers the
+payload with the writer's version so readers can branch on it — the
+ENCODE_START/DECODE_START contract, JSON-shaped.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+class MalformedInput(ValueError):
+    pass
+
+
+def encode(data: Dict[str, Any], version: int = 1,
+           compat: int = 1) -> str:
+    if compat > version:
+        raise ValueError("compat cannot exceed version")
+    return json.dumps({"v": version, "compat": compat, "data": data})
+
+
+def decode(blob: str | bytes,
+           supported: int = 1) -> Tuple[int, Dict[str, Any]]:
+    """Returns (writer_version, payload); raises MalformedInput when
+    the writer demands a newer reader than ``supported``."""
+    try:
+        env = json.loads(blob)
+        v = int(env["v"])
+        compat = int(env["compat"])
+        data = env["data"]
+    except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
+        raise MalformedInput(f"bad envelope: {e}")
+    if compat > supported:
+        raise MalformedInput(
+            f"structure requires decoder v{compat}, have v{supported}")
+    return v, data
+
+
+class Versioned:
+    """Mixin: classes with to_dict/from_dict gain versioned wire forms.
+
+    Subclasses set STRUCT_V/COMPAT_V and may override
+    ``upgrade(writer_v, data)`` to migrate old payloads forward — the
+    role of the per-version branches inside reference decode() bodies.
+    """
+
+    STRUCT_V = 1
+    COMPAT_V = 1
+
+    def encode_versioned(self) -> str:
+        return encode(self.to_dict(), self.STRUCT_V, self.COMPAT_V)
+
+    @classmethod
+    def decode_versioned(cls, blob: str | bytes):
+        v, data = decode(blob, supported=cls.STRUCT_V)
+        data = cls.upgrade(v, data)
+        return cls.from_dict(data)
+
+    @classmethod
+    def upgrade(cls, writer_v: int, data: Dict[str, Any]
+                ) -> Dict[str, Any]:
+        return data

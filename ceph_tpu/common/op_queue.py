@@ -1,0 +1,126 @@
+"""dmClock op scheduler — QoS between op classes.
+
+The role of src/osd/scheduler (OpScheduler/mClockScheduler over the
+vendored dmclock submodule): each op class (client, recovery, scrub,
+...) gets a QoS triple (reservation, weight, limit) in ops/sec, and the
+queue serves by dmClock tag order — reservation tags first (guaranteed
+floor), then weight-proportional sharing below the limit ceiling.
+
+Tag algebra (the dmClock paper's core, as the reference configures it
+via osd_mclock_scheduler_* options):
+
+  R_tag = max(now, prev_R + 1/reservation)
+  L_tag = max(now, prev_L + 1/limit)
+  P_tag = max(now, prev_P + 1/weight)     (normalized share)
+
+``dequeue(now)``: any class whose R_tag <= now is served by earliest
+R_tag (reservation phase); otherwise the earliest P_tag among classes
+with L_tag <= now (weight phase); otherwise None until a tag matures.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from dataclasses import dataclass
+from typing import Deque, Dict, Optional, Tuple
+
+CLIENT = "client"
+RECOVERY = "recovery"
+SCRUB = "scrub"
+
+
+@dataclass
+class ClientInfo:
+    """QoS triple in ops/sec; 0 disables the term."""
+
+    reservation: float = 0.0
+    weight: float = 1.0
+    limit: float = 0.0  # 0 = unlimited
+
+
+class MClockQueue:
+    def __init__(self, qos: Optional[Dict[str, ClientInfo]] = None):
+        self.qos: Dict[str, ClientInfo] = dict(qos or {})
+        self._queues: Dict[str, Deque] = collections.defaultdict(
+            collections.deque)
+        self._r_tag: Dict[str, float] = {}
+        self._l_tag: Dict[str, float] = {}
+        self._p_tag: Dict[str, float] = {}
+
+    def set_qos(self, cls: str, info: ClientInfo) -> None:
+        self.qos[cls] = info
+
+    def enqueue(self, cls: str, item, now: float) -> None:
+        if cls not in self.qos:
+            self.qos[cls] = ClientInfo()
+        q = self._queues[cls]
+        q.append(item)
+        if len(q) == 1:
+            # idle -> active: tags catch up to now but NEVER rewind
+            # (dmClock's max(prev, now) rule — a burst that drains and
+            # re-fills must not defeat its limit)
+            info = self.qos[cls]
+            prev_r = self._r_tag.get(cls, now)
+            if prev_r == math.inf:
+                prev_r = now  # reservation granted since last active
+            self._r_tag[cls] = (max(now, prev_r)
+                                if info.reservation else math.inf)
+            self._l_tag[cls] = max(now, self._l_tag.get(cls, now))
+            self._p_tag[cls] = max(now, self._p_tag.get(cls, now))
+
+    def _advance(self, cls: str, now: float) -> None:
+        info = self.qos[cls]
+        self._r_tag[cls] = (
+            max(now, self._r_tag[cls] + 1.0 / info.reservation)
+            if info.reservation else math.inf)
+        self._l_tag[cls] = (
+            max(now, self._l_tag[cls] + 1.0 / info.limit)
+            if info.limit else now)
+        self._p_tag[cls] = max(
+            now, self._p_tag[cls] + 1.0 / max(1e-9, info.weight))
+
+    def dequeue(self, now: float) -> Optional[Tuple[str, object]]:
+        """The next op to serve at ``now``, or None if every class is
+        tag-throttled (call again later)."""
+        ready = [c for c, q in self._queues.items() if q]
+        if not ready:
+            return None
+        # reservation phase: guaranteed floors first
+        res = [c for c in ready if self._r_tag.get(c, math.inf) <= now]
+        if res:
+            cls = min(res, key=lambda c: self._r_tag[c])
+        else:
+            # weight phase: proportional share below the limit ceiling
+            eligible = [c for c in ready
+                        if self._l_tag.get(c, 0.0) <= now]
+            if not eligible:
+                return None
+            cls = min(eligible, key=lambda c: self._p_tag[c])
+        item = self._queues[cls].popleft()
+        self._advance(cls, now)
+        return cls, item
+
+    def next_ready_at(self) -> float:
+        """Earliest time a throttled dequeue could succeed."""
+        times = []
+        for c, q in self._queues.items():
+            if not q:
+                continue
+            r = self._r_tag.get(c, math.inf)
+            l_ = self._l_tag.get(c, 0.0)
+            times.append(min(r, l_))
+        return min(times) if times else math.inf
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+
+def default_osd_queue() -> MClockQueue:
+    """The balanced profile (osd_mclock_profile=balanced spirit):
+    clients and recovery share, scrub runs in the leftovers."""
+    return MClockQueue({
+        CLIENT: ClientInfo(reservation=40.0, weight=1.0, limit=0.0),
+        RECOVERY: ClientInfo(reservation=20.0, weight=0.5, limit=100.0),
+        SCRUB: ClientInfo(reservation=0.0, weight=0.2, limit=50.0),
+    })

@@ -56,8 +56,9 @@ def _recv_frame(sock: socket.socket) -> Optional[Dict]:
 
 class Messenger:
     def __init__(self, name: str, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, keyring=None):
         self.name = name
+        self.keyring = keyring  # cephx-style frame auth when set
         self._handlers: Dict[str, Handler] = {}
         self._listener = socket.socket(socket.AF_INET,
                                        socket.SOCK_STREAM)
@@ -111,7 +112,15 @@ class Messenger:
         with _send_locks_guard:
             _send_locks.pop(id(conn), None)
 
+    def _sign(self, msg: Dict) -> Dict:
+        if self.keyring is not None:
+            msg = dict(msg)
+            msg["mac"] = self.keyring.sign(msg)
+        return msg
+
     def _dispatch(self, conn: socket.socket, msg: Dict) -> None:
+        if self.keyring is not None and not self.keyring.verify(msg):
+            return  # unauthenticated frame: drop silently (cephx deny)
         type_ = msg.get("type", "")
         if type_ == "__reply__":
             with self._pending_cv:
@@ -129,9 +138,9 @@ class Messenger:
                 reply = {"error": str(e)}
         if msg.get("tid") is not None:
             try:
-                _send_frame(conn, {"type": "__reply__",
-                                   "tid": msg["tid"],
-                                   "payload": reply})
+                _send_frame(conn, self._sign(
+                    {"type": "__reply__", "tid": msg["tid"],
+                     "payload": reply}))
             except OSError:
                 pass
 
@@ -160,6 +169,7 @@ class Messenger:
     def send(self, addr: Addr, msg: Dict) -> None:
         """Fire-and-forget; one silent reconnect attempt (lossy
         policy)."""
+        msg = self._sign(msg)
         for _ in range(2):
             try:
                 _send_frame(self._connect(addr), msg)
@@ -174,12 +184,18 @@ class Messenger:
         same peer keep their replies; a genuinely dead socket raises
         OSError on the next send and is reconnected there."""
         tid = uuid.uuid4().hex
-        msg = dict(msg, tid=tid, frm=self.name)
+        msg = self._sign(dict(msg, tid=tid, frm=self.name))
         deadline = time.monotonic() + timeout
         with self._pending_cv:
             self._waiting.add(tid)
         try:
-            _send_frame(self._connect(addr), msg)
+            try:
+                _send_frame(self._connect(addr), msg)
+            except OSError:
+                # stale cached connection (peer restarted): one fresh
+                # reconnect before giving up
+                self._drop(addr)
+                _send_frame(self._connect(addr), msg)
             with self._pending_cv:
                 while tid not in self._pending:
                     remaining = deadline - time.monotonic()

@@ -23,6 +23,12 @@ from ..osdmap.osdmap import OSDMap, POOL_TYPE_ERASURE
 from ..ec.registry import profile_factory
 
 
+class ObjectNotFound(KeyError):
+    """Every reachable shard holder answered ENOENT — the object does
+    not exist (distinct from transient unreachability, which raises
+    TimeoutError/OSError and is retried)."""
+
+
 def object_to_ps(oid: str) -> int:
     """object name -> placement seed.  The reference uses
     ceph_str_hash_rjenkins (object_locator_to_pg); any fixed 32-bit
@@ -34,10 +40,11 @@ def object_to_ps(oid: str) -> int:
 
 class Client:
     def __init__(self, name: str, mon_addr: Addr,
-                 host: str = "127.0.0.1"):
+                 host: str = "127.0.0.1", keyring=None):
         self.name = name
         self.mon_addr = tuple(mon_addr)
-        self.msgr = Messenger(f"client.{name}", host, 0)
+        self.msgr = Messenger(f"client.{name}", host, 0,
+                              keyring=keyring)
         self.msgr.register("map_update", self._h_map_update)
         self.msgr.start()
         self.map: Optional[OSDMap] = None
@@ -142,6 +149,8 @@ class Client:
                 if code is None:
                     return self._read_replicated(pool_id, ps, oid, up)
                 return self._read_ec(pool_id, ps, oid, up, code)
+            except ObjectNotFound:
+                raise  # definitive: never retried
             except (TimeoutError, OSError, KeyError):
                 if attempt + 1 == retries:
                     raise
@@ -151,6 +160,8 @@ class Client:
 
     def _read_replicated(self, pool_id, ps, oid, up) -> bytes:
         last: Exception = OSError("empty up set")
+        enoent = 0
+        reachable = 0
         for osd in up:
             try:
                 got = self.msgr.call(
@@ -160,8 +171,13 @@ class Client:
             except (TimeoutError, OSError, KeyError) as e:
                 last = e
                 continue
+            reachable += 1
             if "data" in got:
                 return bytes.fromhex(got["data"])[:got["size"]]
+            if got.get("error") == "enoent":
+                enoent += 1
+        if reachable and enoent == reachable:
+            raise ObjectNotFound(oid)
         raise last
 
     def _read_ec(self, pool_id, ps, oid, up, code) -> bytes:
@@ -170,6 +186,8 @@ class Client:
         k = code.get_data_chunk_count()
         chunks: Dict[int, np.ndarray] = {}
         size = None
+        enoent = 0
+        reachable = 0
         for pos, osd in enumerate(up):
             if len(chunks) >= k:
                 break
@@ -180,11 +198,16 @@ class Client:
                      "oid": oid, "shard": pos}, timeout=5)
             except (TimeoutError, OSError, KeyError):
                 continue
+            reachable += 1
             if "data" in got:
                 chunks[pos] = np.frombuffer(
                     bytes.fromhex(got["data"]), np.uint8)
                 size = got["size"]
+            elif got.get("error") == "enoent":
+                enoent += 1
         if len(chunks) < k or size is None:
+            if reachable and enoent == reachable:
+                raise ObjectNotFound(oid)
             raise TimeoutError(
                 f"only {len(chunks)}/{k} shards reachable for {oid}")
         return code.decode_concat(chunks)[:size]

@@ -27,8 +27,11 @@ from .osd_service import OSDService
 
 class MiniCluster:
     def __init__(self, n_osds: int = 4, hosts: Optional[int] = None,
-                 config: Optional[Config] = None):
+                 config: Optional[Config] = None, auth: bool = False):
         self.conf = config or Config()
+        # the out-of-band keyring every daemon/client shares (cephx)
+        from ..msg.auth import Keyring
+        self.keyring = Keyring.generate() if auth else None
         self.n_osds = n_osds
         hosts = hosts or n_osds
         # crush hierarchy through the facade (one host per fd bucket)
@@ -44,7 +47,8 @@ class MiniCluster:
 
         osdmap = OSDMap(self.wrapper.crush)
         self.mon_ctx = Context("mon", config=self.conf)
-        self.mon = Monitor(self.mon_ctx, osdmap)
+        self.mon = Monitor(self.mon_ctx, osdmap,
+                           keyring=self.keyring)
         self.osds: Dict[int, OSDService] = {}
         self.clients: List[Client] = []
 
@@ -63,7 +67,7 @@ class MiniCluster:
         self.mon.shutdown()
 
     def client(self, name: str = "admin") -> Client:
-        c = Client(name, self.mon.addr)
+        c = Client(name, self.mon.addr, keyring=self.keyring)
         self.clients.append(c)
         return c
 
@@ -133,7 +137,8 @@ class MiniCluster:
 
     def revive_osd(self, osd: int) -> OSDService:
         ctx = Context(f"osd.{osd}", config=self.conf)
-        svc = OSDService(ctx, osd, self.mon.addr)
+        svc = OSDService(ctx, osd, self.mon.addr,
+                         keyring=self.keyring)
         svc.start()
         self.osds[osd] = svc
         return svc

@@ -1,0 +1,203 @@
+"""Image — the librbd analogue: a block device striped over objects.
+
+The role of src/librbd at this framework's scope: an image is a
+fixed-size virtual block device carved into stripe pieces
+(``services.striper`` layout) over a pool, with a header object
+carrying geometry and the snapshot table, random-offset read/write via
+read-modify-write on the backing pieces, resize (shrink discards
+truncated data, as the block-device contract requires), and
+point-in-time snapshots with rollback.  Snapshots remember their size,
+so a later shrink doesn't truncate history.
+
+Divergence note: the reference snapshots in place via RADOS
+self-managed snaps (object clones inside the same PG); here a snapshot
+materializes copies under ``name@snap`` piece names — the user-visible
+semantics (immutable point-in-time view, rollback, independent reads)
+are preserved; the storage cost differs.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from .client import Client, ObjectNotFound
+from .striper import Striper, _piece_name
+
+
+def _header_oid(name: str) -> str:
+    return f"rbd_header.{name}"
+
+
+class ImageError(Exception):
+    pass
+
+
+class Image:
+    def __init__(self, client: Client, pool_id: int, name: str,
+                 header: Dict):
+        self.client = client
+        self.pool_id = pool_id
+        self.name = name
+        self._h = header
+        self.striper = Striper(client,
+                               stripe_unit=header["stripe_unit"],
+                               stripe_count=header["stripe_count"],
+                               object_size=header["object_size"])
+
+    # -- lifecycle ------------------------------------------------------
+    @classmethod
+    def create(cls, client: Client, pool_id: int, name: str,
+               size: int, stripe_unit: int = 4096,
+               stripe_count: int = 4,
+               object_size: int = 1 << 16) -> "Image":
+        try:
+            client.get(pool_id, _header_oid(name))
+        except ObjectNotFound:
+            pass  # the only evidence the image does NOT exist;
+            # transient errors (TimeoutError/OSError) propagate so a
+            # degraded moment can never silently clobber a header
+        else:
+            raise ImageError(f"image {name!r} exists")
+        header = {"size": size, "stripe_unit": stripe_unit,
+                  "stripe_count": stripe_count,
+                  "object_size": object_size, "snaps": []}
+        client.put(pool_id, _header_oid(name),
+                   json.dumps(header).encode())
+        return cls(client, pool_id, name, header)
+
+    @classmethod
+    def open(cls, client: Client, pool_id: int, name: str) -> "Image":
+        try:
+            raw = client.get(pool_id, _header_oid(name))
+        except ObjectNotFound:
+            raise ImageError(f"no image {name!r}")
+        return cls(client, pool_id, name, json.loads(raw.decode()))
+
+    def _save_header(self) -> None:
+        self.client.put(self.pool_id, _header_oid(self.name),
+                        json.dumps(self._h).encode())
+
+    # -- geometry -------------------------------------------------------
+    @property
+    def size(self) -> int:
+        return self._h["size"]
+
+    def resize(self, size: int) -> None:
+        """Grow or shrink.  Shrinking discards the truncated bytes so a
+        later grow reads zeros there (the block-device contract)."""
+        old = self.size
+        if size < old:
+            boundary = None
+            drop = set()
+            for objectno, obj_off, log_off, _run in \
+                    self.striper.extent_map(size, old - size):
+                if log_off == size and obj_off:
+                    boundary = (objectno, obj_off)
+                else:
+                    drop.add(objectno)
+            if boundary is not None:
+                objectno, keep = boundary
+                piece = self._piece(self.name, objectno)[:keep]
+                self.client.put(self.pool_id,
+                                _piece_name(self.name, objectno),
+                                piece)
+                drop.discard(objectno)
+            for objectno in sorted(drop):
+                self.client.put(self.pool_id,
+                                _piece_name(self.name, objectno), b"")
+        self._h["size"] = size
+        self._save_header()
+
+    def snaps(self) -> List[str]:
+        return [s["name"] for s in self._h["snaps"]]
+
+    def _snap(self, snap: str) -> Dict:
+        for s in self._h["snaps"]:
+            if s["name"] == snap:
+                return s
+        raise ImageError(f"no snap {snap!r}")
+
+    # -- data path (read-modify-write over stripe pieces) ---------------
+    def _piece(self, data_name: str, objectno: int) -> bytes:
+        try:
+            return self.client.get(self.pool_id,
+                                   _piece_name(data_name, objectno))
+        except ObjectNotFound:
+            return b""  # sparse: unwritten pieces read as zeros
+
+    def write(self, offset: int, data: bytes) -> int:
+        if offset + len(data) > self.size:
+            raise ImageError("write past end of image")
+        touched: Dict[int, bytearray] = {}
+        for objectno, obj_off, log_off, run in \
+                self.striper.extent_map(offset, len(data)):
+            buf = touched.get(objectno)
+            if buf is None:
+                buf = bytearray(self._piece(self.name, objectno))
+                touched[objectno] = buf
+            if len(buf) < obj_off + run:
+                buf.extend(b"\0" * (obj_off + run - len(buf)))
+            buf[obj_off:obj_off + run] = \
+                data[log_off - offset:log_off - offset + run]
+        for objectno, buf in sorted(touched.items()):
+            self.client.put(self.pool_id,
+                            _piece_name(self.name, objectno),
+                            bytes(buf))
+        return len(data)
+
+    def _read_pieces(self, data_name: str, offset: int, length: int,
+                     limit: int) -> bytes:
+        length = max(0, min(length, limit - offset))
+        if not length:
+            return b""
+        out = bytearray(length)  # unwritten extents read as zeros
+        cache: Dict[int, bytes] = {}
+        for objectno, obj_off, log_off, run in \
+                self.striper.extent_map(offset, length):
+            piece = cache.get(objectno)
+            if piece is None:
+                piece = self._piece(data_name, objectno)
+                cache[objectno] = piece
+            chunk = piece[obj_off:obj_off + run]
+            out[log_off - offset:log_off - offset + len(chunk)] = chunk
+        return bytes(out)
+
+    def read(self, offset: int, length: int) -> bytes:
+        return self._read_pieces(self.name, offset, length, self.size)
+
+    # -- snapshots -------------------------------------------------------
+    def _pieces_in_use(self, size: int) -> List[int]:
+        objs = set()
+        for objectno, _o, _l, _r in self.striper.extent_map(0, size):
+            objs.add(objectno)
+        return sorted(objs)
+
+    def snapshot(self, snap: str) -> None:
+        if any(s["name"] == snap for s in self._h["snaps"]):
+            raise ImageError(f"snap {snap!r} exists")
+        for objectno in self._pieces_in_use(self.size):
+            piece = self._piece(self.name, objectno)
+            if piece:
+                self.client.put(
+                    self.pool_id,
+                    _piece_name(f"{self.name}@{snap}", objectno),
+                    piece)
+        self._h["snaps"].append({"name": snap, "size": self.size})
+        self._save_header()
+
+    def read_snap(self, snap: str, offset: int, length: int) -> bytes:
+        info = self._snap(snap)
+        return self._read_pieces(f"{self.name}@{snap}", offset,
+                                 length, info["size"])
+
+    def rollback(self, snap: str) -> None:
+        """Restore the image data (and size) to the snapshot's state."""
+        info = self._snap(snap)
+        for objectno in self._pieces_in_use(
+                max(info["size"], self.size)):
+            piece = self._piece(f"{self.name}@{snap}", objectno)
+            self.client.put(self.pool_id,
+                            _piece_name(self.name, objectno), piece)
+        self._h["size"] = info["size"]
+        self._save_header()

@@ -31,11 +31,11 @@ from ..osdmap.osdmap import OSDMap, PgPool
 class Monitor:
     def __init__(self, ctx: Context, osdmap: OSDMap,
                  host: str = "127.0.0.1", port: int = 0,
-                 store_dir: Optional[str] = None):
+                 store_dir: Optional[str] = None, keyring=None):
         self.ctx = ctx
         self.log = ctx.logger("mon")
         self.map = osdmap
-        self.msgr = Messenger("mon", host, port)
+        self.msgr = Messenger("mon", host, port, keyring=keyring)
         self.addr: Addr = self.msgr.addr
         self.store_dir = store_dir
         self._epochs: Dict[int, str] = {}  # epoch -> map json

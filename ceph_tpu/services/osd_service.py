@@ -37,13 +37,14 @@ def pg_cid(pool_id: int, ps: int) -> str:
 
 class OSDService:
     def __init__(self, ctx: Context, osd_id: int, mon_addr: Addr,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0, keyring=None):
         self.ctx = ctx
         self.id = osd_id
         self.log = ctx.logger("osd")
         self.mon_addr = tuple(mon_addr)
         self.store = MemStore()
-        self.msgr = Messenger(f"osd.{osd_id}", host, port)
+        self.msgr = Messenger(f"osd.{osd_id}", host, port,
+                              keyring=keyring)
         self.addr = self.msgr.addr
         self.map: Optional[OSDMap] = None
         self.epoch = 0

@@ -1,0 +1,211 @@
+"""dmClock scheduler, cephx-style auth, KV wrapper, versioned
+encoding — the remaining §2.5 foundation rows."""
+
+import time
+
+import pytest
+
+from ceph_tpu.common.encoding import (MalformedInput, Versioned,
+                                      decode, encode)
+from ceph_tpu.common.op_queue import (CLIENT, RECOVERY, SCRUB,
+                                      ClientInfo, MClockQueue,
+                                      default_osd_queue)
+from ceph_tpu.msg.auth import Keyring
+from ceph_tpu.msg.messenger import Messenger
+from ceph_tpu.os.kv import KeyValueDB, KVTransaction
+
+
+# -- dmClock ----------------------------------------------------------------
+
+def test_mclock_reservation_floor():
+    """A class with a reservation gets its floor even against a
+    heavier competitor."""
+    q = MClockQueue({
+        CLIENT: ClientInfo(reservation=0, weight=10.0),
+        RECOVERY: ClientInfo(reservation=5.0, weight=0.1),
+    })
+    now = 0.0
+    for i in range(100):
+        q.enqueue(CLIENT, f"c{i}", now)
+        q.enqueue(RECOVERY, f"r{i}", now)
+    served = {CLIENT: 0, RECOVERY: 0}
+    # one simulated second at 20 ops/sec service rate
+    for tick in range(20):
+        got = q.dequeue(now)
+        assert got is not None
+        served[got[0]] += 1
+        now += 0.05
+    # recovery's 5 ops/sec floor over 1s => ~5 served despite weight 0.1
+    assert served[RECOVERY] >= 4
+    assert served[CLIENT] > served[RECOVERY]  # weight still dominates
+
+
+def test_mclock_limit_ceiling():
+    q = MClockQueue({
+        SCRUB: ClientInfo(reservation=0, weight=1.0, limit=2.0),
+    })
+    now = 0.0
+    for i in range(10):
+        q.enqueue(SCRUB, i, now)
+    served = 0
+    for tick in range(100):
+        if q.dequeue(now) is not None:
+            served += 1
+        now += 0.01  # one simulated second total
+    assert served <= 3  # 2 ops/sec limit (+1 for the t=0 op)
+
+
+def test_mclock_weight_sharing_and_idle():
+    q = MClockQueue({
+        "a": ClientInfo(weight=3.0),
+        "b": ClientInfo(weight=1.0),
+    })
+    now = 0.0
+    for i in range(40):
+        q.enqueue("a", i, now)
+        q.enqueue("b", i, now)
+    served = {"a": 0, "b": 0}
+    for _ in range(24):
+        cls, _item = q.dequeue(now)
+        served[cls] += 1
+        now += 0.001
+    assert served["a"] > 2.0 * served["b"]  # ~3:1 sharing
+    assert len(default_osd_queue().qos) == 3
+
+
+def test_mclock_next_ready():
+    q = MClockQueue({SCRUB: ClientInfo(weight=1.0, limit=1.0)})
+    q.enqueue(SCRUB, "x", 0.0)
+    assert q.dequeue(0.0) is not None
+    q.enqueue(SCRUB, "y", 0.001)
+    assert q.dequeue(0.001) is None  # limit-throttled
+    assert 0.9 < q.next_ready_at() <= 1.1
+    assert q.dequeue(1.1) is not None
+
+
+# -- auth -------------------------------------------------------------------
+
+def test_keyring_sign_verify_and_tickets():
+    k = Keyring.generate()
+    msg = {"type": "boot", "osd": 1}
+    signed = dict(msg, mac=k.sign(msg))
+    assert k.verify(signed)
+    signed["osd"] = 2  # tamper
+    assert not k.verify(signed)
+    k2 = Keyring.from_hex(k.to_hex())
+    t = k2.issue_ticket("client.admin", lifetime=60)
+    assert k.verify_ticket(t)
+    t_expired = k.issue_ticket("x", lifetime=-1)
+    assert not k.verify_ticket(t_expired)
+    t["name"] = "client.evil"
+    assert not k.verify_ticket(t)
+
+
+def test_messenger_rejects_unauthenticated():
+    key = Keyring.generate()
+    server = Messenger("srv", keyring=key)
+    server.register("ping", lambda m: {"pong": True})
+    server.start()
+    good = Messenger("good", keyring=Keyring.from_hex(key.to_hex()))
+    good.start()
+    bad = Messenger("bad")  # no keyring
+    bad.start()
+    wrong = Messenger("wrong", keyring=Keyring.generate())
+    wrong.start()
+    try:
+        assert good.call(server.addr, {"type": "ping"}) == \
+            {"pong": True}
+        with pytest.raises(TimeoutError):
+            bad.call(server.addr, {"type": "ping"}, timeout=0.6)
+        with pytest.raises(TimeoutError):
+            wrong.call(server.addr, {"type": "ping"}, timeout=0.6)
+    finally:
+        for m in (server, good, bad, wrong):
+            m.shutdown()
+
+
+def test_authenticated_cluster_end_to_end():
+    from ceph_tpu.common.config import Config
+    from ceph_tpu.services.cluster import MiniCluster
+
+    conf = Config()
+    conf.set("osd_heartbeat_interval", 0.2)
+    conf.set("osd_heartbeat_grace", 1.5)
+    cl = MiniCluster(n_osds=3, config=conf, auth=True).start()
+    try:
+        cl.create_replicated_pool(1, pg_num=4, size=2)
+        c = cl.client("authed")
+        c.put(1, "o", b"secured payload")
+        assert c.get(1, "o") == b"secured payload"
+        # an unauthenticated messenger cannot talk to the mon at all
+        intruder = Messenger("intruder")
+        intruder.start()
+        try:
+            with pytest.raises(TimeoutError):
+                intruder.call(cl.mon.addr, {"type": "status"},
+                              timeout=0.6)
+        finally:
+            intruder.shutdown()
+    finally:
+        cl.shutdown()
+
+
+# -- kv wrapper -------------------------------------------------------------
+
+def test_kv_roundtrip_and_prefixes():
+    db = KeyValueDB()
+    db.submit_transaction(
+        KVTransaction().set("osdmap", "epoch", b"7")
+        .set("osdmap", "fsid", b"abc").set("pg", "1.0", b"log"))
+    assert db.get("osdmap", "epoch") == b"7"
+    assert db.get_by_prefix("osdmap") == {"epoch": b"7",
+                                          "fsid": b"abc"}
+    assert list(db.iterator("osdmap"))[0] == ("epoch", b"7")
+    db.submit_transaction(KVTransaction().rmkey("osdmap", "fsid"))
+    assert db.get("osdmap", "fsid") is None
+    db.submit_transaction(KVTransaction().rmkeys_by_prefix("osdmap"))
+    assert db.get_by_prefix("osdmap") == {}
+    assert db.get("pg", "1.0") == b"log"  # other prefixes untouched
+
+
+# -- versioned encoding -----------------------------------------------------
+
+def test_encoding_envelope():
+    blob = encode({"x": 1}, version=3, compat=2)
+    v, data = decode(blob, supported=3)
+    assert (v, data) == (3, {"x": 1})
+    with pytest.raises(MalformedInput):
+        decode(blob, supported=1)  # too old to read compat=2
+    with pytest.raises(MalformedInput):
+        decode("not json")
+    with pytest.raises(ValueError):
+        encode({}, version=1, compat=2)
+
+
+def test_versioned_mixin_upgrade():
+    class Thing(Versioned):
+        STRUCT_V = 2
+        COMPAT_V = 1
+
+        def __init__(self, a, b):
+            self.a, self.b = a, b
+
+        def to_dict(self):
+            return {"a": self.a, "b": self.b}
+
+        @classmethod
+        def from_dict(cls, d):
+            return cls(d["a"], d["b"])
+
+        @classmethod
+        def upgrade(cls, writer_v, data):
+            if writer_v < 2:
+                data = dict(data, b=0)  # field added in v2
+            return data
+
+    t = Thing(1, 2)
+    t2 = Thing.decode_versioned(t.encode_versioned())
+    assert (t2.a, t2.b) == (1, 2)
+    old_blob = encode({"a": 9}, version=1, compat=1)
+    t3 = Thing.decode_versioned(old_blob)
+    assert (t3.a, t3.b) == (9, 0)
