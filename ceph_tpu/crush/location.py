@@ -1,0 +1,55 @@
+"""CrushLocation — where a daemon lives in the hierarchy.
+
+The role of src/crush/CrushLocation.cc: each OSD declares its position
+as ``type=name`` pairs ("root=default rack=r1 host=node3"), sourced
+from the ``crush_location`` config option (or a hook script in the
+reference); on boot the map is updated with create-or-move semantics
+(`ceph osd crush create-or-move`) so daemons land in the right failure
+domain automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from .wrapper import CrushWrapper
+
+
+def parse_loc(spec: str) -> Dict[str, str]:
+    """'root=default host=node1' -> {'root': 'default', ...}
+    (CrushLocation::update_from_conf parsing; '=' required)."""
+    out: Dict[str, str] = {}
+    for token in spec.replace(",", " ").split():
+        key, sep, value = token.partition("=")
+        if not sep or not key or not value:
+            raise ValueError(f"bad crush location token {token!r}")
+        out[key] = value
+    return out
+
+
+def format_loc(loc: Dict[str, str]) -> str:
+    return " ".join(f"{k}={v}" for k, v in sorted(loc.items()))
+
+
+def default_location(hostname: str,
+                     root: str = "default") -> Dict[str, str]:
+    """The reference's fallback: host=<hostname> root=default."""
+    return {"host": hostname, "root": root}
+
+
+def create_or_move_item(wrapper: CrushWrapper, item: int, weight: int,
+                        name: str, loc: Dict[str, str]) -> bool:
+    """`ceph osd crush create-or-move` semantics: insert when absent,
+    relocate (keeping the existing weight) when present at a different
+    location.  Returns True when the map changed."""
+    if not wrapper.name_map.get(item):
+        wrapper.insert_item(item, weight, name, loc)
+        return True
+    parent = wrapper.get_immediate_parent_id(item)
+    want_bucket = wrapper._loc_bucket(loc, create=True)
+    if parent == want_bucket:
+        return False
+    cur_weight = wrapper.get_item_weight(item)
+    wrapper.remove_item(item)
+    wrapper.insert_item(item, cur_weight, name, loc)
+    return True

@@ -141,7 +141,14 @@ class Client:
         if not got.get("ok"):
             raise OSError(f"shard_write to osd.{osd}: {got}")
 
-    def get(self, pool_id: int, oid: str, retries: int = 3) -> bytes:
+    def get(self, pool_id: int, oid: str, retries: int = 3,
+            notfound_retries: int = 2) -> bytes:
+        """``notfound_retries`` covers the read-races-backfill window:
+        a just-remapped up set answers ENOENT for an object that exists
+        on the old holders until recovery copies it over.  Callers that
+        expect sparse misses (image pieces, existence probes) pass 0
+        for fast definitive ENOENT."""
+        nf_left = notfound_retries
         for attempt in range(retries):
             pool, ps, up = self._up(pool_id, oid)
             code = self._code_for(pool)
@@ -150,7 +157,11 @@ class Client:
                     return self._read_replicated(pool_id, ps, oid, up)
                 return self._read_ec(pool_id, ps, oid, up, code)
             except ObjectNotFound:
-                raise  # definitive: never retried
+                if nf_left <= 0:
+                    raise
+                nf_left -= 1
+                time.sleep(0.3)
+                self.refresh_map()
             except (TimeoutError, OSError, KeyError):
                 if attempt + 1 == retries:
                     raise

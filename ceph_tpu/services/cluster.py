@@ -27,11 +27,15 @@ from .osd_service import OSDService
 
 class MiniCluster:
     def __init__(self, n_osds: int = 4, hosts: Optional[int] = None,
-                 config: Optional[Config] = None, auth: bool = False):
+                 config: Optional[Config] = None, auth: bool = False,
+                 data_dir: Optional[str] = None):
         self.conf = config or Config()
         # the out-of-band keyring every daemon/client shares (cephx)
         from ..msg.auth import Keyring
         self.keyring = Keyring.generate() if auth else None
+        # when set, OSDs persist their stores under data_dir/osd<N>
+        # and restarts remount instead of backfilling from scratch
+        self.data_dir = data_dir
         self.n_osds = n_osds
         hosts = hosts or n_osds
         # crush hierarchy through the facade (one host per fd bucket)
@@ -47,8 +51,14 @@ class MiniCluster:
 
         osdmap = OSDMap(self.wrapper.crush)
         self.mon_ctx = Context("mon", config=self.conf)
+        mon_store = None
+        if data_dir is not None:
+            import os
+
+            mon_store = os.path.join(data_dir, "mon")
         self.mon = Monitor(self.mon_ctx, osdmap,
-                           keyring=self.keyring)
+                           keyring=self.keyring,
+                           store_dir=mon_store)
         self.osds: Dict[int, OSDService] = {}
         self.clients: List[Client] = []
 
@@ -137,8 +147,13 @@ class MiniCluster:
 
     def revive_osd(self, osd: int) -> OSDService:
         ctx = Context(f"osd.{osd}", config=self.conf)
+        data_dir = None
+        if self.data_dir is not None:
+            import os
+
+            data_dir = os.path.join(self.data_dir, f"osd{osd}")
         svc = OSDService(ctx, osd, self.mon.addr,
-                         keyring=self.keyring)
+                         keyring=self.keyring, data_dir=data_dir)
         svc.start()
         self.osds[osd] = svc
         return svc

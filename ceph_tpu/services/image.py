@@ -52,7 +52,7 @@ class Image:
                stripe_count: int = 4,
                object_size: int = 1 << 16) -> "Image":
         try:
-            client.get(pool_id, _header_oid(name))
+            client.get(pool_id, _header_oid(name), notfound_retries=0)
         except ObjectNotFound:
             pass  # the only evidence the image does NOT exist;
             # transient errors (TimeoutError/OSError) propagate so a
@@ -84,28 +84,29 @@ class Image:
         return self._h["size"]
 
     def resize(self, size: int) -> None:
-        """Grow or shrink.  Shrinking discards the truncated bytes so a
-        later grow reads zeros there (the block-device contract)."""
+        """Grow or shrink.  Shrinking zeroes exactly the truncated
+        extents so a later grow reads zeros there (the block-device
+        contract).  Striping interleaves live and truncated stripe
+        units within one backing object, so truncation must patch
+        per-extent — never drop whole objects."""
         old = self.size
         if size < old:
-            boundary = None
-            drop = set()
-            for objectno, obj_off, log_off, _run in \
+            touched: Dict[int, bytearray] = {}
+            for objectno, obj_off, _log_off, run in \
                     self.striper.extent_map(size, old - size):
-                if log_off == size and obj_off:
-                    boundary = (objectno, obj_off)
-                else:
-                    drop.add(objectno)
-            if boundary is not None:
-                objectno, keep = boundary
-                piece = self._piece(self.name, objectno)[:keep]
+                buf = touched.get(objectno)
+                if buf is None:
+                    buf = bytearray(self._piece(self.name, objectno))
+                    touched[objectno] = buf
+                if len(buf) > obj_off:
+                    end = min(len(buf), obj_off + run)
+                    buf[obj_off:end] = b"\0" * (end - obj_off)
+            for objectno, buf in sorted(touched.items()):
+                # trailing zeros are reconstructible (sparse reads
+                # return zeros), so trim them from storage
                 self.client.put(self.pool_id,
                                 _piece_name(self.name, objectno),
-                                piece)
-                drop.discard(objectno)
-            for objectno in sorted(drop):
-                self.client.put(self.pool_id,
-                                _piece_name(self.name, objectno), b"")
+                                bytes(buf).rstrip(b"\0"))
         self._h["size"] = size
         self._save_header()
 
@@ -121,8 +122,11 @@ class Image:
     # -- data path (read-modify-write over stripe pieces) ---------------
     def _piece(self, data_name: str, objectno: int) -> bytes:
         try:
+            # sparse images miss pieces constantly: definitive ENOENT,
+            # no backfill-race retries on this path
             return self.client.get(self.pool_id,
-                                   _piece_name(data_name, objectno))
+                                   _piece_name(data_name, objectno),
+                                   notfound_retries=0)
         except ObjectNotFound:
             return b""  # sparse: unwritten pieces read as zeros
 

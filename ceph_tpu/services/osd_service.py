@@ -37,12 +37,17 @@ def pg_cid(pool_id: int, ps: int) -> str:
 
 class OSDService:
     def __init__(self, ctx: Context, osd_id: int, mon_addr: Addr,
-                 host: str = "127.0.0.1", port: int = 0, keyring=None):
+                 host: str = "127.0.0.1", port: int = 0, keyring=None,
+                 data_dir: Optional[str] = None):
         self.ctx = ctx
         self.id = osd_id
         self.log = ctx.logger("osd")
         self.mon_addr = tuple(mon_addr)
-        self.store = MemStore()
+        # data_dir = the OSD's persistent volume (superblock + data):
+        # a restart remounts the checkpoint instead of backfilling
+        # everything from peers (the reference's restart-replay flow)
+        self.data_dir = data_dir
+        self.store = self._mount()
         self.msgr = Messenger(f"osd.{osd_id}", host, port,
                               keyring=keyring)
         self.addr = self.msgr.addr
@@ -58,6 +63,9 @@ class OSDService:
         self._recover_wake = threading.Event()
         self.backfill_throttle = Throttle(
             "backfill", ctx.conf["osd_max_backfills"])
+        from ..common.op_tracker import OpTracker
+
+        self.optracker = OpTracker()
         self.pc = ctx.perf.create(f"osd.{osd_id}")
         for key in ("ops_w", "ops_r", "recovered_objects",
                     "map_epochs"):
@@ -71,6 +79,37 @@ class OSDService:
                      ("map_update", self._h_map_update),
                      ("status", self._h_status)):
             self.msgr.register(t, h)
+
+    # -- persistence (superblock/restart-replay role) -------------------
+    def _checkpoint_path(self) -> Optional[str]:
+        if self.data_dir is None:
+            return None
+        import os
+
+        os.makedirs(self.data_dir, exist_ok=True)
+        return os.path.join(self.data_dir, f"osd.{self.id}.store.json")
+
+    def _mount(self) -> MemStore:
+        import json
+        import os
+
+        path = self._checkpoint_path()
+        if path and os.path.exists(path):
+            with open(path) as f:
+                return MemStore.import_state(json.load(f))
+        return MemStore()
+
+    def _flush(self) -> None:
+        import json
+
+        path = self._checkpoint_path()
+        if path:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self.store.export_state(), f)
+            import os
+
+            os.replace(tmp, path)  # atomic superblock swap
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -99,6 +138,10 @@ class OSDService:
         self._running = False
         self._recover_wake.set()
         self.msgr.shutdown()
+        try:
+            self._flush()
+        except OSError as e:
+            self.log.derr(f"checkpoint flush failed: {e}")
 
     # -- map handling --------------------------------------------------
     def _install_map(self, payload: Dict) -> None:
@@ -143,32 +186,39 @@ class OSDService:
 
         cid = pg_cid(msg["pool"], msg["ps"])
         oid = f"{msg['oid']}.s{msg['shard']}"
-        txn = Transaction()
-        if not self.store.collection_exists(cid):
-            txn.create_collection(cid)
-        data = bytes.fromhex(msg["data"])
-        txn.write(cid, oid, 0, data)
-        txn.setattr(cid, oid, "size", str(msg["size"]).encode())
-        txn.setattr(cid, oid, "crc", str(crc32c(data)).encode())
-        seq = str(time.time_ns())
-        txn.omap_setkeys(cid, "pglog", {
-            seq: f'{{"op":"write","oid":"{msg["oid"]}",'
-                 f'"shard":{msg["shard"]},"epoch":{self.epoch}}}'
-                 .encode()})
-        self.store.queue_transaction(txn)
-        self.pc.inc("ops_w")
+        with self.optracker.create(
+                "osd_op", f"write {cid}/{oid} from "
+                          f"{msg.get('frm')}") as op:
+            txn = Transaction()
+            if not self.store.collection_exists(cid):
+                txn.create_collection(cid)
+            data = bytes.fromhex(msg["data"])
+            txn.write(cid, oid, 0, data)
+            txn.setattr(cid, oid, "size", str(msg["size"]).encode())
+            txn.setattr(cid, oid, "crc", str(crc32c(data)).encode())
+            seq = str(time.time_ns())
+            txn.omap_setkeys(cid, "pglog", {
+                seq: f'{{"op":"write","oid":"{msg["oid"]}",'
+                     f'"shard":{msg["shard"]},"epoch":{self.epoch}}}'
+                     .encode()})
+            op.mark_event("queued_for_store")
+            self.store.queue_transaction(txn)
+            op.mark_event("commit")
+            self.pc.inc("ops_w")
         return {"ok": True, "epoch": self.epoch}
 
     def _h_shard_read(self, msg: Dict) -> Dict:
         cid = pg_cid(msg["pool"], msg["ps"])
         oid = f"{msg['oid']}.s{msg['shard']}"
-        try:
-            data = self.store.read(cid, oid)
-        except KeyError:
-            return {"error": "enoent"}
-        size = self.store.getattr(cid, oid, "size") or b"0"
-        self.pc.inc("ops_r")
-        return {"data": data.hex(), "size": int(size)}
+        with self.optracker.create("osd_op",
+                                   f"read {cid}/{oid}"):
+            try:
+                data = self.store.read(cid, oid)
+            except KeyError:
+                return {"error": "enoent"}
+            size = self.store.getattr(cid, oid, "size") or b"0"
+            self.pc.inc("ops_r")
+            return {"data": data.hex(), "size": int(size)}
 
     def _h_pg_list(self, msg: Dict) -> Dict:
         cid = pg_cid(msg["pool"], msg["ps"])
@@ -218,7 +268,8 @@ class OSDService:
         with self._lock:
             return {"osd": self.id, "epoch": self.epoch,
                     "collections": self.store.list_collections(),
-                    "perf": self.pc.dump()}
+                    "perf": self.pc.dump(),
+                    "historic_ops": self.optracker.dump_historic_ops()}
 
     # -- heartbeats ----------------------------------------------------
     def _beat_loop(self) -> None:

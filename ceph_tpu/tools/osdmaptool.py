@@ -98,6 +98,9 @@ def main(argv=None) -> int:
     p.add_argument("--pg-bits", type=int, default=6)
     p.add_argument("--clobber", action="store_true")
     p.add_argument("--test-map-pgs", action="store_true")
+    p.add_argument("--test-map-pgs-dump", action="store_true",
+                   help="print every pg's up set + primary "
+                        "(osdmaptool.cc:42)")
     p.add_argument("--pool", type=int, default=None)
     p.add_argument("--scalar", action="store_true",
                    help="scalar pipeline instead of batched")
@@ -165,6 +168,16 @@ def main(argv=None) -> int:
                     f.write(f"ceph osd pg-upmap-items {tag} {pairs}\n")
         print(f"upmap: {changed} changes")
         dirty = dirty or changed > 0
+
+    if args.test_map_pgs_dump:
+        for pool_id, pool in sorted(m.pools.items()):
+            if args.pool is not None and pool_id != args.pool:
+                continue
+            for ps in range(pool.pg_num):
+                up, up_p, acting, act_p = m.pg_to_up_acting_osds(
+                    pool_id, ps)
+                print(f"{pool_id}.{ps:x}\t{list(up)}\t{up_p}\t"
+                      f"{list(acting)}\t{act_p}")
 
     if args.test_map_pgs:
         test_map_pgs(m, args.pool, use_batched=not args.scalar)

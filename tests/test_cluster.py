@@ -214,6 +214,14 @@ def test_image_block_device_over_ec(cluster):
     assert img2.read(50_000, 6) == b"\0" * 6
     # snapshots keep their own size across a shrink
     assert img2.read_snap("s1", 0, 12) == b"BOOT" * 3
+    # shrink must NOT clobber live data interleaved in the same
+    # backing object as truncated stripe units
+    img2.write(0, b"LIVE" * 128)         # unit 0 -> object 0
+    img2.write(3 * 512, b"gone" * 128)   # later unit, same object set
+    img2.resize(512)                     # keep only unit 0
+    img2.resize(1 << 17)
+    assert img2.read(0, 512) == b"LIVE" * 128
+    assert img2.read(3 * 512, 512) == b"\0" * 512
 
 
 def test_map_epoch_catchup(cluster):

@@ -1,0 +1,62 @@
+"""EC non-regression corpus check + OpTracker — the cross-version
+parity archive (ceph_erasure_code_non_regression.cc role) and the
+in-flight/slow-op introspection (TrackedOp.h role)."""
+
+import time
+
+import pytest
+
+from ceph_tpu.common.op_tracker import OpTracker
+from ceph_tpu.tools.ec_non_regression import DEFAULT_BASE, check_all
+
+
+def test_corpus_non_regression():
+    """Every archived corpus entry must re-encode byte-identically and
+    decode from its ARCHIVED chunks under every single erasure."""
+    entries = [p for p in DEFAULT_BASE.iterdir() if p.is_dir()]
+    assert len(entries) >= 6  # jerasure x2, isa, lrc, shec, clay
+    assert check_all(DEFAULT_BASE) == []
+
+
+def test_op_tracker_inflight_and_history():
+    t = OpTracker(history_size=4, history_slow_threshold=0.05)
+    op = t.create("osd_op", "write 1.0/obj")
+    assert t.dump_ops_in_flight()["num_ops"] == 1
+    op.mark_event("commit")
+    op.finish()
+    assert t.dump_ops_in_flight()["num_ops"] == 0
+    hist = t.dump_historic_ops()
+    assert hist["num_ops"] == 1 and hist["served_total"] == 1
+    events = [e["event"] for e in hist["ops"][0]["events"]]
+    assert events == ["initiated", "commit", "done"]
+
+    # slow-op capture
+    slow = t.create("osd_op", "slow one")
+    time.sleep(0.06)
+    slow.finish()
+    assert len(t.dump_historic_slow_ops()["ops"]) == 1
+
+    # history ring is bounded
+    for i in range(10):
+        t.create("x", str(i)).finish()
+    assert t.dump_historic_ops()["num_ops"] == 4
+    assert t.dump_historic_ops()["served_total"] == 12
+
+
+def test_op_tracker_context_manager_and_admin(tmp_path):
+    from ceph_tpu.common.admin_socket import AdminSocket
+
+    t = OpTracker()
+    with t.create("osd_op", "ctx"):
+        assert t.dump_ops_in_flight()["num_ops"] == 1
+    assert t.dump_ops_in_flight()["num_ops"] == 0
+
+    sock = AdminSocket(str(tmp_path / "a.asok"))
+    t.wire(sock)
+    sock.start()
+    try:
+        got = AdminSocket.request(str(tmp_path / "a.asok"),
+                                  "dump_historic_ops")
+        assert got["num_ops"] == 1
+    finally:
+        sock.shutdown()

@@ -217,6 +217,20 @@ def test_osdmaptool_flow(tmp_path):
         assert "pg-upmap-items" in text
 
 
+def test_osdmaptool_dump(tmp_path, capsys):
+    mapfn = tmp_path / "om.json"
+    assert osdmaptool.main([str(mapfn), "--createsimple", "4",
+                            "--pg-bits", "2"]) == 0
+    capsys.readouterr()
+    assert osdmaptool.main([str(mapfn), "--test-map-pgs-dump",
+                            "--scalar"]) == 0
+    lines = [l for l in capsys.readouterr().out.splitlines()
+             if l.startswith("1.")]
+    assert len(lines) == 16  # 4 osds << 2 pg bits
+    pgid, up, up_p, acting, act_p = lines[0].split("\t")
+    assert pgid == "1.0" and int(up_p) >= 0
+
+
 def test_ec_benchmark_cli(capsys):
     assert ec_benchmark.main(
         ["--plugin", "jerasure", "-P", "k=4", "-P", "m=2",
