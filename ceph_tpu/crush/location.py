@@ -37,19 +37,36 @@ def default_location(hostname: str,
     return {"host": hostname, "root": root}
 
 
+def _lowest_existing(wrapper: CrushWrapper,
+                     loc: Dict[str, str]):
+    """The id of loc's lowest bucket if it already exists — a PURE
+    lookup (no bucket creation/linking side effects)."""
+    order = sorted((wrapper.get_type_id(t), n) for t, n in loc.items())
+    if not order:
+        raise ValueError("empty crush location")
+    _tid, name = order[0]
+    return wrapper.get_item_id(name) if wrapper.name_exists(name) \
+        else None
+
+
 def create_or_move_item(wrapper: CrushWrapper, item: int, weight: int,
                         name: str, loc: Dict[str, str]) -> bool:
     """`ceph osd crush create-or-move` semantics: insert when absent,
-    relocate (keeping the existing weight) when present at a different
-    location.  Returns True when the map changed."""
+    relocate (keeping the existing weight AND device class) when the
+    direct parent differs.  Returns True when the map changed; a
+    no-move call leaves the map untouched (no speculative bucket
+    creation)."""
     if not wrapper.name_map.get(item):
         wrapper.insert_item(item, weight, name, loc)
         return True
     parent = wrapper.get_immediate_parent_id(item)
-    want_bucket = wrapper._loc_bucket(loc, create=True)
-    if parent == want_bucket:
+    if parent is not None and \
+            parent == _lowest_existing(wrapper, loc):
         return False
     cur_weight = wrapper.get_item_weight(item)
+    cur_class = wrapper.get_item_class(item)
     wrapper.remove_item(item)
     wrapper.insert_item(item, cur_weight, name, loc)
+    if cur_class is not None:  # remove_item pops the class; restore
+        wrapper.set_item_class(item, cur_class)
     return True

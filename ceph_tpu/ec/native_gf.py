@@ -1,0 +1,95 @@
+"""Native GF(2^8) matmul — the EC engine's CPU twin.
+
+The isa-l role on the host: RS encode/decode as table-driven GF(2^8)
+matrix application (native/crush_host.cpp gf8_matmul, OpenMP over
+rows).  The TPU path stays the MXU bit-matmul (engine.BitCode /
+pallas_kernels); this backs the bench's CPU fallback and host tools so
+the EC throughput number is a real engine on every platform.
+
+Parity is identical to the array engines by construction: both apply
+the SAME generator matrices (gf.py) over the same field (poly 0x11D),
+pinned by tests.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, Sequence
+
+import numpy as np
+
+from . import gf
+from ..crush.native import ensure_built
+
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_wired = False
+
+
+def _lib():
+    global _wired
+    lib = ensure_built()
+    if lib is None:
+        return None
+    if not _wired:
+        lib.gf8_matmul.restype = ctypes.c_int
+        lib.gf8_matmul.argtypes = [
+            ctypes.c_int, ctypes.c_int, _u8p, _u8p, _u8p,
+            ctypes.c_int64,
+        ]
+        _wired = True
+    return lib
+
+
+def available() -> bool:
+    return _lib() is not None
+
+
+def gf8_matmul(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """(rows, k) GF(2^8) matrix @ u8[k, L] -> u8[rows, L]."""
+    lib = _lib()
+    if lib is None:
+        raise RuntimeError("native gf engine unavailable")
+    mat = np.ascontiguousarray(mat, np.uint8)
+    data = np.ascontiguousarray(data, np.uint8)
+    rows, k = mat.shape
+    assert data.shape[0] == k
+    out = np.zeros((rows, data.shape[1]), np.uint8)
+    lib.gf8_matmul(rows, k, mat, data, out,
+                   np.int64(data.shape[1]))
+    return out
+
+
+class NativeRS:
+    """RS(k, m) on the native engine — mirrors rs_jax.RSCode's array
+    API for host-side callers."""
+
+    def __init__(self, k: int, m: int, technique: str = "reed_sol_van"):
+        self.k, self.m = k, m
+        if technique in ("reed_sol_van", "vandermonde"):
+            self.G = gf.rs_vandermonde_matrix(k, m)
+        else:
+            self.G = gf.rs_cauchy_matrix(k, m)
+        self._dec_cache: Dict[tuple, np.ndarray] = {}
+
+    def encode(self, data: np.ndarray) -> np.ndarray:
+        return gf8_matmul(np.asarray(self.G[self.k:], np.uint8), data)
+
+    def all_chunks(self, data: np.ndarray) -> np.ndarray:
+        return np.concatenate([np.asarray(data, np.uint8),
+                               self.encode(data)], axis=0)
+
+    def decode(self, chunks: Dict[int, np.ndarray],
+               erasures: Sequence[int]) -> np.ndarray:
+        present = tuple(sorted(
+            i for i in chunks if i not in set(erasures)))[:self.k]
+        if len(present) < self.k:
+            raise ValueError("need at least k chunks")
+        dm = self._dec_cache.get(present)
+        if dm is None:
+            dm = np.asarray(
+                gf.decode_matrix(self.G, list(present), self.k),
+                np.uint8)
+            self._dec_cache[present] = dm
+        stack = np.stack([np.asarray(chunks[i], np.uint8)
+                          for i in present])
+        return gf8_matmul(dm, stack)

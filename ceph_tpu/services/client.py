@@ -149,7 +149,10 @@ class Client:
         expect sparse misses (image pieces, existence probes) pass 0
         for fast definitive ENOENT."""
         nf_left = notfound_retries
-        for attempt in range(retries):
+        transient_left = retries - 1  # separate budgets: an ENOENT
+        # retry must never convert into OSError('unreachable') when the
+        # miss is definitive — callers branch on ObjectNotFound
+        while True:
             pool, ps, up = self._up(pool_id, oid)
             code = self._code_for(pool)
             try:
@@ -160,14 +163,12 @@ class Client:
                 if nf_left <= 0:
                     raise
                 nf_left -= 1
-                time.sleep(0.3)
-                self.refresh_map()
             except (TimeoutError, OSError, KeyError):
-                if attempt + 1 == retries:
+                if transient_left <= 0:
                     raise
-                time.sleep(0.3)
-                self.refresh_map()
-        raise OSError("unreachable")
+                transient_left -= 1
+            time.sleep(0.3)
+            self.refresh_map()
 
     def _read_replicated(self, pool_id, ps, oid, up) -> bytes:
         last: Exception = OSError("empty up set")

@@ -91,22 +91,23 @@ class Image:
         per-extent — never drop whole objects."""
         old = self.size
         if size < old:
-            touched: Dict[int, bytearray] = {}
-            for objectno, obj_off, _log_off, run in \
+            # within one backing object, logical offsets grow with
+            # obj_off, so the truncated region is a contiguous TAIL:
+            # keep [0, min truncated obj_off) and drop the rest.  A
+            # boundary of 0 means the whole object goes — no read
+            # needed (large shrinks don't transfer the tail back).
+            boundary: Dict[int, int] = {}
+            for objectno, obj_off, _log_off, _run in \
                     self.striper.extent_map(size, old - size):
-                buf = touched.get(objectno)
-                if buf is None:
-                    buf = bytearray(self._piece(self.name, objectno))
-                    touched[objectno] = buf
-                if len(buf) > obj_off:
-                    end = min(len(buf), obj_off + run)
-                    buf[obj_off:end] = b"\0" * (end - obj_off)
-            for objectno, buf in sorted(touched.items()):
-                # trailing zeros are reconstructible (sparse reads
-                # return zeros), so trim them from storage
+                cur = boundary.get(objectno)
+                if cur is None or obj_off < cur:
+                    boundary[objectno] = obj_off
+            for objectno, keep in sorted(boundary.items()):
+                piece = b"" if keep == 0 else \
+                    self._piece(self.name, objectno)[:keep]
                 self.client.put(self.pool_id,
                                 _piece_name(self.name, objectno),
-                                bytes(buf).rstrip(b"\0"))
+                                piece.rstrip(b"\0"))
         self._h["size"] = size
         self._save_header()
 

@@ -76,7 +76,6 @@ def check_entry(entry: pathlib.Path) -> list:
             failures.append(f"{entry.name}: chunk {i} re-encode "
                             f"differs from archive")
     # decode the ARCHIVED chunks (what old clusters actually stored)
-    k = code.get_data_chunk_count()
     for erased in range(n):
         avail = {i: c for i, c in archived.items() if i != erased}
         try:

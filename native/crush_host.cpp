@@ -480,7 +480,7 @@ void choose_indep(const MapView& m, const Tunables& t, Workspace& work,
 int do_rule_one(const MapView& m, const Tunables& tun, int nsteps,
                 const int32_t* steps, const uint32_t* weight,
                 int weight_len, uint32_t x, int result_max,
-                int32_t* result) {
+                int32_t* result, Workspace& work) {
   std::vector<int32_t> wv(result_max), ov(result_max), cv(result_max);
   int32_t* w = wv.data();
   int32_t* o = ov.data();
@@ -494,8 +494,6 @@ int do_rule_one(const MapView& m, const Tunables& tun, int nsteps,
   int local_fallback_retries = tun.local_fallback_tries;
   int vary_r = tun.vary_r;
   int stable = tun.stable;
-
-  Workspace work(m.B);
 
   for (int s = 0; s < nsteps; s++) {
     int op = steps[s * 3], arg1 = steps[s * 3 + 1],
@@ -610,12 +608,76 @@ int crush_do_rule_batched(
   Tunables t{choose_local_tries, choose_local_fallback_tries,
              choose_total_tries, chooseleaf_descend_once,
              chooseleaf_vary_r, chooseleaf_stable};
-  // each x owns its workspace and output row: embarrassingly parallel
-#pragma omp parallel for schedule(dynamic, 256)
-  for (int i = 0; i < nx; i++) {
-    result_lens[i] = do_rule_one(m, t, nsteps, steps, weight, weight_len,
-                                 xs[i], result_max,
-                                 results + (size_t)i * result_max);
+  // Each x owns its output row: embarrassingly parallel.  The uniform-
+  // bucket permutation workspace is allocated once per THREAD and
+  // reused across xs — perm state is keyed by perm_x, so a different x
+  // rebuilds on first touch (the same invalidation rule the per-x
+  // workspace relied on), and per-x allocation of B PermStates was
+  // the dominant overhead on bucket-heavy maps.
+#pragma omp parallel
+  {
+    Workspace work(m.B);
+#pragma omp for schedule(dynamic, 256)
+    for (int i = 0; i < nx; i++) {
+      result_lens[i] = do_rule_one(m, t, nsteps, steps, weight,
+                                   weight_len, xs[i], result_max,
+                                   results + (size_t)i * result_max,
+                                   work);
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"
+
+// ---- GF(2^8) table matmul — the EC host/CPU engine ------------------------
+//
+// The isa-l role on the host side: encode/decode as a GF(2^8) matrix
+// applied via 256-entry multiply tables (poly 0x11D, the gf-complete
+// default this framework's field uses).  The TPU path is the MXU
+// bit-matmul; this is its native CPU twin for benches and host tools.
+
+namespace {
+
+uint8_t g_gf_mul[256][256];
+bool g_gf_ready = false;
+
+void gf8_init() {
+  if (g_gf_ready) return;
+  for (int a = 0; a < 256; a++) {
+    for (int b = 0; b < 256; b++) {
+      int r = 0, aa = a, bb = b;
+      while (bb) {
+        if (bb & 1) r ^= aa;
+        bb >>= 1;
+        aa <<= 1;
+        if (aa & 0x100) aa ^= 0x11D;
+      }
+      g_gf_mul[a][b] = (uint8_t)r;
+    }
+  }
+  g_gf_ready = true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// out[rows, L] = mat[rows, k] (GF(2^8)) * data[k, L]
+int gf8_matmul(int rows, int k, const uint8_t* mat,
+               const uint8_t* data, uint8_t* out, int64_t L) {
+  gf8_init();
+#pragma omp parallel for schedule(static)
+  for (int r = 0; r < rows; r++) {
+    uint8_t* dst = out + (size_t)r * L;
+    std::memset(dst, 0, (size_t)L);
+    for (int j = 0; j < k; j++) {
+      const uint8_t c = mat[r * k + j];
+      if (!c) continue;
+      const uint8_t* tab = g_gf_mul[c];
+      const uint8_t* src = data + (size_t)j * L;
+      for (int64_t i = 0; i < L; i++) dst[i] ^= tab[src[i]];
+    }
   }
   return 0;
 }

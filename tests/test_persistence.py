@@ -46,6 +46,24 @@ def test_create_or_move_item():
     assert w.get_bucket(w.get_item_id("h1")).items == []
 
 
+def test_create_or_move_keeps_class_and_is_pure_on_noop():
+    w = CrushWrapper()
+    create_or_move_item(w, 0, 0x10000, "osd.0",
+                        parse_loc("root=default host=h1"))
+    w.set_item_class(0, "ssd")
+    buckets_before = len(w.crush.buckets)
+    # no-op with an EXTRA (nonexistent) level must not create buckets
+    assert not create_or_move_item(
+        w, 0, 0x10000, "osd.0",
+        parse_loc("root=default rack=rX host=h1"))
+    assert len(w.crush.buckets) == buckets_before
+    assert not w.name_exists("rX")
+    # a real move keeps the device class
+    create_or_move_item(w, 0, 0x10000, "osd.0",
+                        parse_loc("root=default host=h2"))
+    assert w.get_item_class(0) == "ssd"
+
+
 def test_osd_restart_remounts_data(tmp_path):
     conf = Config()
     conf.set("osd_heartbeat_interval", 0.2)
