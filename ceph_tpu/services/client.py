@@ -38,7 +38,10 @@ def object_to_ps(oid: str) -> int:
         hashlib.sha256(oid.encode()).digest()[:4], "little")
 
 
-class Client:
+from .map_follower import MapFollower
+
+
+class Client(MapFollower):
     def __init__(self, name: str, mon_addr: Addr,
                  host: str = "127.0.0.1", keyring=None):
         self.name = name
@@ -46,6 +49,7 @@ class Client:
         self.msgr = Messenger(f"client.{name}", host, 0,
                               keyring=keyring)
         self.msgr.register("map_update", self._h_map_update)
+        self.msgr.register("map_inc", self._h_map_inc)
         self.msgr.start()
         self.map: Optional[OSDMap] = None
         self.epoch = 0
@@ -63,16 +67,6 @@ class Client:
         self.msgr.shutdown()
 
     # -- map -----------------------------------------------------------
-    def _install_map(self, payload: Dict) -> None:
-        with self._lock:
-            if payload["epoch"] <= self.epoch:
-                return
-            self.map = OSDMap.from_dict(payload["map"])
-            self.epoch = payload["epoch"]
-            self.osd_addrs = {int(k): tuple(v) for k, v in
-                              payload.get("osd_addrs", {}).items()}
-            self.ec_profiles = payload.get("ec_profiles", {})
-
     def _h_map_update(self, msg: Dict) -> None:
         self._install_map(msg["payload"])
         return None

@@ -1,0 +1,91 @@
+"""MapFollower — the MonClient role: follow OSDMap epochs.
+
+Shared by every map subscriber (OSD services, clients): install full
+maps, apply incremental deltas COPY-AND-SWAP (readers holding the old
+map object keep a consistent snapshot — placements are never computed
+from a half-applied epoch), and catch up across gaps by walking the
+monitor's retained incrementals (``get_inc``), falling back to one
+full ``get_map`` only when an epoch has aged out — the O(change)
+distribution contract.
+
+Users provide ``_lock``, ``map``, ``epoch``, ``osd_addrs``,
+``ec_profiles``, ``msgr``, ``mon_addr`` and may override
+``_post_map_install()`` (called after every successful install, not
+under the lock).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from ..osdmap.incremental import Incremental, apply_incremental
+from ..osdmap.osdmap import OSDMap
+
+
+class MapFollower:
+    def _set_extras(self, msg: Dict) -> None:
+        """osd address table + EC profiles travel beside the map
+        (call under self._lock)."""
+        if "osd_addrs" in msg:
+            self.osd_addrs = {int(k): tuple(v)
+                              for k, v in msg["osd_addrs"].items()}
+        if "ec_profiles" in msg:
+            self.ec_profiles = msg["ec_profiles"]
+
+    def _install_map(self, payload: Dict) -> None:
+        with self._lock:
+            if payload["epoch"] <= self.epoch:
+                return
+            self.map = OSDMap.from_dict(payload["map"])
+            self.epoch = payload["epoch"]
+            self._set_extras(payload)
+        self._post_map_install()
+
+    def _apply_one_inc(self, inc: Incremental) -> bool:
+        """Copy-apply-swap under the lock; False when not contiguous."""
+        with self._lock:
+            if self.map is None or inc.epoch != self.epoch + 1:
+                return False
+            new = OSDMap.from_dict(self.map.to_dict())
+            apply_incremental(new, inc)
+            self.map = new
+            self.epoch = inc.epoch
+            return True
+
+    def _h_map_inc(self, msg: Dict) -> None:
+        inc = Incremental.from_dict(msg["inc"])
+        with self._lock:
+            if inc.epoch <= self.epoch:
+                return None
+        if self._apply_one_inc(inc):
+            with self._lock:
+                self._set_extras(msg)
+            self._post_map_install()
+            return None
+        self._catch_up(inc.epoch, msg)
+        return None
+
+    def _catch_up(self, target: int, msg: Dict) -> None:
+        """Walk missing epochs via get_inc; full fetch on aged-out
+        history.  Best-effort: the monitor re-pushes on every commit."""
+        try:
+            while self.epoch < target and self.map is not None:
+                got = self.msgr.call(
+                    self.mon_addr,
+                    {"type": "get_inc", "epoch": self.epoch + 1},
+                    timeout=5)
+                inc_d = got.get("inc")
+                if inc_d is None or not self._apply_one_inc(
+                        Incremental.from_dict(inc_d)):
+                    self._install_map(self.msgr.call(
+                        self.mon_addr, {"type": "get_map"},
+                        timeout=5))
+                    return
+            with self._lock:
+                self._set_extras(msg)
+            self._post_map_install()
+        except (TimeoutError, OSError):
+            pass  # the next push catches us up
+
+    def _post_map_install(self) -> None:  # pragma: no cover - hook
+        pass

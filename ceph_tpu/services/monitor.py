@@ -39,6 +39,10 @@ class Monitor:
         self.addr: Addr = self.msgr.addr
         self.store_dir = store_dir
         self._epochs: Dict[int, str] = {}  # epoch -> map json
+        # epoch -> Incremental dict (map distribution is O(change):
+        # subscribers apply deltas, fetching a full map only on a gap)
+        self._incs: Dict[int, Dict] = {}
+        self._prev_map: Optional[OSDMap] = None
         self._osd_addrs: Dict[int, Addr] = {}
         self._last_beat: Dict[int, float] = {}
         self._down_since: Dict[int, float] = {}
@@ -55,6 +59,7 @@ class Monitor:
         for t, h in (("boot", self._h_boot),
                      ("heartbeat", self._h_heartbeat),
                      ("get_map", self._h_get_map),
+                     ("get_inc", self._h_get_inc),
                      ("subscribe", self._h_subscribe),
                      ("mark_down", self._h_mark_down),
                      ("mark_out", self._h_mark_out),
@@ -80,14 +85,23 @@ class Monitor:
 
     # -- the epoch store (MonitorDBStore role) --------------------------
     def _commit(self, why: str) -> int:
-        """Bump the epoch, retain the full map, persist, notify."""
+        """Bump the epoch, retain the full map AND its delta, persist,
+        notify."""
+        from ..osdmap.incremental import diff_maps
+
         with self._lock:
             self.map.epoch += 1
             payload = json.dumps(self._map_payload())
             self._epochs[self.map.epoch] = payload
+            if self._prev_map is not None:
+                inc = diff_maps(self._prev_map, self.map)
+                inc.epoch = self.map.epoch
+                self._incs[self.map.epoch] = inc.to_dict()
+            self._prev_map = OSDMap.from_dict(self.map.to_dict())
             keep = self.ctx.conf["mon_max_map_epochs"]
             for e in sorted(self._epochs)[:-keep]:
                 del self._epochs[e]
+                self._incs.pop(e, None)
             if self.store_dir:
                 os.makedirs(self.store_dir, exist_ok=True)
                 with open(os.path.join(
@@ -114,11 +128,27 @@ class Monitor:
 
     def _push_maps(self) -> None:
         with self._lock:
-            payload = json.loads(self._epochs[self.map.epoch])
+            epoch = self.map.epoch
+            inc = self._incs.get(epoch)
+            payload = None if inc is not None else \
+                json.loads(self._epochs[epoch])
+            extras = {"osd_addrs": {str(k): list(v) for k, v in
+                                    self._osd_addrs.items()},
+                      "ec_profiles": dict(self.ec_profiles)}
             subs = list(self._subscribers.values())
         for addr in subs:
-            self.msgr.send(addr, {"type": "map_update",
-                                  "payload": payload})
+            if inc is not None:
+                self.msgr.send(addr, {"type": "map_inc", "inc": inc,
+                                      **extras})
+            else:
+                self.msgr.send(addr, {"type": "map_update",
+                                      "payload": payload})
+
+    def _h_get_inc(self, msg: Dict) -> Dict:
+        with self._lock:
+            got = self._incs.get(int(msg["epoch"]))
+        return {"inc": got} if got is not None else \
+            {"error": f"no incremental for epoch {msg['epoch']}"}
 
     # -- handlers --------------------------------------------------------
     def _h_boot(self, msg: Dict) -> Dict:

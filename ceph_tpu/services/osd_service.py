@@ -35,7 +35,10 @@ def pg_cid(pool_id: int, ps: int) -> str:
     return f"{pool_id}.{ps}"
 
 
-class OSDService:
+from .map_follower import MapFollower
+
+
+class OSDService(MapFollower):
     def __init__(self, ctx: Context, osd_id: int, mon_addr: Addr,
                  host: str = "127.0.0.1", port: int = 0, keyring=None,
                  data_dir: Optional[str] = None):
@@ -77,6 +80,7 @@ class OSDService:
                      ("pg_scrub", self._h_pg_scrub),
                      ("shard_remove", self._h_shard_remove),
                      ("map_update", self._h_map_update),
+                     ("map_inc", self._h_map_inc),
                      ("status", self._h_status)):
             self.msgr.register(t, h)
 
@@ -143,24 +147,18 @@ class OSDService:
         except OSError as e:
             self.log.derr(f"checkpoint flush failed: {e}")
 
-    # -- map handling --------------------------------------------------
-    def _install_map(self, payload: Dict) -> None:
+    # -- map handling (install/inc-apply live in MapFollower) ----------
+    def _post_map_install(self) -> None:
         with self._lock:
-            if payload["epoch"] <= self.epoch:
-                return
-            self.map = OSDMap.from_dict(payload["map"])
-            self.epoch = payload["epoch"]
-            self.osd_addrs = {int(k): tuple(v) for k, v in
-                              payload.get("osd_addrs", {}).items()}
-            self.ec_profiles = payload.get("ec_profiles", {})
-            wrongly_down = self._running and \
-                not self.map.is_up(self.id)
+            wrongly_down = self._running and self.map is not None \
+                and not self.map.is_up(self.id)
+            epoch = self.epoch
         self.pc.inc("map_epochs")
         if wrongly_down:
             # we observed our own markdown but we're alive: re-boot to
             # the mon (the reference OSD's "map says I'm down" flow)
             self.log.dout(1, f"osd.{self.id} marked down in epoch "
-                             f"{payload['epoch']}; re-booting to mon")
+                             f"{epoch}; re-booting to mon")
             self.msgr.send(self.mon_addr,
                            {"type": "boot", "osd": self.id,
                             "addr": list(self.addr)})

@@ -639,24 +639,29 @@ int crush_do_rule_batched(
 
 namespace {
 
-uint8_t g_gf_mul[256][256];
-bool g_gf_ready = false;
-
-void gf8_init() {
-  if (g_gf_ready) return;
-  for (int a = 0; a < 256; a++) {
-    for (int b = 0; b < 256; b++) {
-      int r = 0, aa = a, bb = b;
-      while (bb) {
-        if (bb & 1) r ^= aa;
-        bb >>= 1;
-        aa <<= 1;
-        if (aa & 0x100) aa ^= 0x11D;
+struct GfTables {
+  uint8_t mul[256][256];
+  GfTables() {
+    for (int a = 0; a < 256; a++) {
+      for (int b = 0; b < 256; b++) {
+        int r = 0, aa = a, bb = b;
+        while (bb) {
+          if (bb & 1) r ^= aa;
+          bb >>= 1;
+          aa <<= 1;
+          if (aa & 0x100) aa ^= 0x11D;
+        }
+        mul[a][b] = (uint8_t)r;
       }
-      g_gf_mul[a][b] = (uint8_t)r;
     }
   }
-  g_gf_ready = true;
+};
+
+const GfTables& gf_tables() {
+  // function-local static: C++11 guarantees thread-safe one-time
+  // construction (ctypes calls arrive GIL-free from many threads)
+  static const GfTables t;
+  return t;
 }
 
 }  // namespace
@@ -666,7 +671,7 @@ extern "C" {
 // out[rows, L] = mat[rows, k] (GF(2^8)) * data[k, L]
 int gf8_matmul(int rows, int k, const uint8_t* mat,
                const uint8_t* data, uint8_t* out, int64_t L) {
-  gf8_init();
+  const GfTables& t = gf_tables();
 #pragma omp parallel for schedule(static)
   for (int r = 0; r < rows; r++) {
     uint8_t* dst = out + (size_t)r * L;
@@ -674,7 +679,7 @@ int gf8_matmul(int rows, int k, const uint8_t* mat,
     for (int j = 0; j < k; j++) {
       const uint8_t c = mat[r * k + j];
       if (!c) continue;
-      const uint8_t* tab = g_gf_mul[c];
+      const uint8_t* tab = t.mul[c];
       const uint8_t* src = data + (size_t)j * L;
       for (int64_t i = 0; i < L; i++) dst[i] ^= tab[src[i]];
     }
