@@ -1,0 +1,252 @@
+"""WALStore — the crash-consistent disk-backed ObjectStore.
+
+The BlueStore role (src/os/bluestore/BlueStore.cc WAL/deferred writes,
+src/os/ObjectStore.h atomicity contract), re-shaped for this framework:
+state lives in RAM (a MemStore twin — the OSD working set), durability
+comes from a write-ahead log plus periodic checkpoints:
+
+  queue_transaction:  apply in-memory (atomic copy-swap — an invalid
+                      txn never journals) → append WAL record → fsync
+                      → return (the ack point: a returned transaction
+                      is durable)
+  checkpoint:         snapshot full state to a temp file → fsync →
+                      atomic rename over ``checkpoint`` → truncate WAL
+  mount:              load newest valid checkpoint, replay WAL records
+                      with seq > checkpoint seq, stopping at the first
+                      torn/corrupt record (a kill -9 mid-append leaves
+                      a torn tail; everything before it was acked and
+                      must survive — everything after was never acked)
+
+Record format (binary, little-endian):
+  magic u32 | seq u64 | len u32 | crc32c u32 | payload(len)
+payload = bincode-encoded Transaction op list.  crc32c is the same
+vectorized castagnoli the EC HashInfo path uses, so torn or bit-rotted
+tails are detected, not replayed.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Dict, List, Optional
+
+from ..common.bincode import (DecodeError, Decoder, Encoder, decode_txn,
+                              encode_txn)
+from .memstore import MemStore, _Object
+from .objectstore import ObjectStore, Transaction
+
+_MAGIC = 0x57414C31  # "WAL1"
+_HDR = struct.Struct("<IQII")
+
+
+def _crc32c(data: bytes) -> int:
+    from ..ec.stripe import crc32c as _c
+
+    return int(_c(data))
+
+
+class WALStore(ObjectStore):
+    def __init__(self, path: str, checkpoint_every_bytes: int = 1 << 24,
+                 sync: bool = True):
+        self.path = path
+        self._mem = MemStore()
+        self._wal_path = os.path.join(path, "wal.log")
+        self._ckpt_path = os.path.join(path, "checkpoint")
+        self._wal_f = None
+        self._seq = 0  # last durable txn seq
+        self._ckpt_seq = 0
+        self._wal_bytes = 0
+        self._ckpt_every = checkpoint_every_bytes
+        self._sync = sync
+        self._lock = threading.RLock()
+
+    # -- lifecycle ----------------------------------------------------
+    def mkfs(self) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        self._write_checkpoint(seq=0)
+        with open(self._wal_path, "wb") as f:
+            f.flush()
+            os.fsync(f.fileno())
+
+    def mount(self) -> None:
+        with self._lock:
+            self._load_checkpoint()
+            valid_end = self._replay_wal()
+            # a torn tail must be CUT, not appended past: records
+            # written after garbage bytes would be unreachable to the
+            # next replay, silently dropping acked transactions
+            try:
+                size = os.path.getsize(self._wal_path)
+            except FileNotFoundError:
+                size = 0
+                open(self._wal_path, "wb").close()
+            if valid_end < size:
+                with open(self._wal_path, "r+b") as f:
+                    f.truncate(valid_end)
+                    f.flush()
+                    os.fsync(f.fileno())
+            self._wal_f = open(self._wal_path, "ab")
+            self._wal_bytes = self._wal_f.tell()
+
+    def umount(self) -> None:
+        with self._lock:
+            if self._wal_f is not None:
+                self.checkpoint()
+                self._wal_f.close()
+                self._wal_f = None
+
+    # -- the write path -----------------------------------------------
+    def queue_transaction(self, txn: Transaction) -> None:
+        with self._lock:
+            assert self._wal_f is not None, "not mounted"
+            # 1. validate + apply in memory (atomic: all ops or none)
+            self._mem.queue_transaction(txn)
+            # 2. journal; the fsync below is the ack point
+            self._seq += 1
+            enc = Encoder()
+            encode_txn(txn.ops, enc)
+            payload = enc.bytes()
+            rec = _HDR.pack(_MAGIC, self._seq, len(payload),
+                            _crc32c(payload)) + payload
+            self._wal_f.write(rec)
+            self._wal_f.flush()
+            if self._sync:
+                os.fsync(self._wal_f.fileno())
+            self._wal_bytes += len(rec)
+            if self._wal_bytes >= self._ckpt_every:
+                self.checkpoint()
+
+    # -- checkpointing ------------------------------------------------
+    def checkpoint(self) -> None:
+        """Fold the WAL into a durable snapshot and truncate it."""
+        with self._lock:
+            self._write_checkpoint(self._seq)
+            self._ckpt_seq = self._seq
+            if self._wal_f is not None:
+                self._wal_f.close()
+            # crash after the rename but before this truncate replays
+            # records with seq <= ckpt seq; the seq check skips them
+            self._wal_f = open(self._wal_path, "wb")
+            if self._sync:
+                os.fsync(self._wal_f.fileno())
+            self._wal_bytes = 0
+
+    def _write_checkpoint(self, seq: int) -> None:
+        os.makedirs(self.path, exist_ok=True)
+        enc = Encoder()
+        enc.start(1, 1)
+        enc.u64(seq)
+        colls = self._mem._coll
+        enc.u32(len(colls))
+        for cid in sorted(colls):
+            enc.str_(cid)
+            objs = colls[cid]
+            enc.u32(len(objs))
+            for oid in sorted(objs):
+                o = objs[oid]
+                enc.str_(oid)
+                enc.blob(bytes(o.data))
+                enc.str_blob_map(o.xattr)
+                enc.str_blob_map(o.omap)
+        enc.finish()
+        body = enc.bytes()
+        tmp = self._ckpt_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_HDR.pack(_MAGIC, seq, len(body), _crc32c(body)))
+            f.write(body)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._ckpt_path)  # atomic on POSIX
+        if self._sync:
+            dirfd = os.open(self.path, os.O_RDONLY)
+            try:
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
+
+    def _load_checkpoint(self) -> None:
+        self._mem = MemStore()
+        self._seq = self._ckpt_seq = 0
+        try:
+            raw = open(self._ckpt_path, "rb").read()
+        except FileNotFoundError:
+            return
+        if len(raw) < _HDR.size:
+            return  # mkfs crashed mid-write; empty store
+        magic, seq, ln, crc = _HDR.unpack_from(raw)
+        body = raw[_HDR.size:_HDR.size + ln]
+        if magic != _MAGIC or len(body) != ln or _crc32c(body) != crc:
+            raise RuntimeError(f"corrupt checkpoint at {self._ckpt_path}")
+        dec = Decoder(body)
+        dec.start(1)
+        got_seq = dec.u64()
+        assert got_seq == seq
+        colls: Dict[str, Dict[str, _Object]] = {}
+        for _ in range(dec.u32()):
+            cid = dec.str_()
+            objs: Dict[str, _Object] = {}
+            for _ in range(dec.u32()):
+                oid = dec.str_()
+                o = _Object()
+                o.data = bytearray(dec.blob())
+                o.xattr = dec.str_blob_map()
+                o.omap = dec.str_blob_map()
+                objs[oid] = o
+            colls[cid] = objs
+        dec.finish()
+        self._mem._coll = colls
+        self._seq = self._ckpt_seq = seq
+
+    def _replay_wal(self) -> int:
+        """Apply WAL records past the checkpoint; stop at the first
+        torn/corrupt record (the un-acked tail).  Returns the byte
+        offset of the end of the last valid record, so mount can
+        truncate the torn tail before appending."""
+        try:
+            raw = open(self._wal_path, "rb").read()
+        except FileNotFoundError:
+            return 0
+        pos = 0
+        while pos + _HDR.size <= len(raw):
+            magic, seq, ln, crc = _HDR.unpack_from(raw, pos)
+            if magic != _MAGIC or pos + _HDR.size + ln > len(raw):
+                break  # torn tail
+            payload = raw[pos + _HDR.size:pos + _HDR.size + ln]
+            if _crc32c(payload) != crc:
+                break  # torn/corrupt tail
+            if seq <= self._ckpt_seq:
+                pos += _HDR.size + ln
+                continue  # folded into the checkpoint already
+            try:
+                ops = decode_txn(Decoder(payload))
+            except DecodeError:
+                break
+            pos += _HDR.size + ln
+            txn = Transaction()
+            txn.ops = ops
+            self._mem.queue_transaction(txn)
+            self._seq = seq
+        return pos
+
+    # -- reads delegate to the in-memory twin -------------------------
+    def read(self, cid, oid, offset=0, length=-1) -> bytes:
+        return self._mem.read(cid, oid, offset, length)
+
+    def stat(self, cid, oid) -> Optional[Dict]:
+        return self._mem.stat(cid, oid)
+
+    def getattr(self, cid, oid, key) -> Optional[bytes]:
+        return self._mem.getattr(cid, oid, key)
+
+    def omap_get(self, cid, oid) -> Dict[str, bytes]:
+        return self._mem.omap_get(cid, oid)
+
+    def list_collections(self) -> List[str]:
+        return self._mem.list_collections()
+
+    def list_objects(self, cid) -> List[str]:
+        return self._mem.list_objects(cid)
+
+    def collection_exists(self, cid) -> bool:
+        return self._mem.collection_exists(cid)

@@ -46,6 +46,9 @@ class Monitor:
         self._osd_addrs: Dict[int, Addr] = {}
         self._last_beat: Dict[int, float] = {}
         self._down_since: Dict[int, float] = {}
+        # osd -> pre-out weight, for osds the MONITOR outed (auto-out);
+        # restored on boot, unlike an admin mark_out which sticks
+        self._auto_out: Dict[int, int] = {}
         self._subscribers: Dict[str, Addr] = {}
         self._lock = threading.RLock()
         self._ticker: Optional[threading.Thread] = None
@@ -158,11 +161,26 @@ class Monitor:
             addr_changed = self._osd_addrs.get(osd) != addr
             self._osd_addrs[osd] = addr
             self._last_beat[osd] = time.monotonic()
-            existed = self.map.exists(osd) and self.map.is_up(osd)
-            self.map.add_osd(osd, weight=msg.get("weight", 0x10000))
-        if not existed or addr_changed:
+            was_up = self.map.exists(osd) and self.map.is_up(osd)
+            # weight policy on boot (OSDMonitor::prepare_boot): an osd
+            # the monitor auto-outed comes back in; an osd an admin
+            # marked out (weight 0 via mark_out) STAYS out; a known osd
+            # keeps whatever weight it had
+            if self.map.exists(osd):
+                weight = self.map.osd_weight[osd]
+                if osd in self._auto_out:
+                    weight = self._auto_out[osd]
+            else:
+                weight = msg.get("weight", 0x10000)
+            changed = (not was_up) or \
+                weight != (self.map.osd_weight[osd]
+                           if self.map.exists(osd) else None)
+            self._auto_out.pop(osd, None)
+            self.map.add_osd(osd, weight=weight)
+        if changed or addr_changed:
             # a fast reboot keeps the osd "up" but rebinds its socket:
-            # the new address must reach every peer via a new epoch
+            # the new address must reach every peer via a new epoch;
+            # any weight/up change must also land in the epoch store
             self._commit(f"osd.{osd} boot")
         self.log.dout(1, f"osd.{osd} booted at {msg['addr']}")
         return {"epoch": self.map.epoch}
@@ -194,6 +212,7 @@ class Monitor:
         osd = int(msg["osd"])
         with self._lock:
             self.map.osd_weight[osd] = 0
+            self._auto_out.pop(osd, None)  # admin out sticks
         return {"epoch": self._commit(f"osd.{osd} out")}
 
     def _h_pool_create(self, msg: Dict) -> Dict:
@@ -259,5 +278,6 @@ class Monitor:
             for osd in to_out:
                 self.log.dout(1, f"osd.{osd} auto-out")
                 with self._lock:
+                    self._auto_out[osd] = self.map.osd_weight[osd]
                     self.map.osd_weight[osd] = 0
                 self._commit(f"osd.{osd} auto-out")

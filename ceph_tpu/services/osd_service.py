@@ -85,35 +85,30 @@ class OSDService(MapFollower):
             self.msgr.register(t, h)
 
     # -- persistence (superblock/restart-replay role) -------------------
-    def _checkpoint_path(self) -> Optional[str]:
+    def _mount(self):
+        """Without a data_dir the OSD is a pure in-RAM daemon
+        (MemStore); with one, it runs the crash-consistent WALStore —
+        every acked transaction survives kill -9, and a restart
+        remounts checkpoint+WAL instead of backfilling from peers (the
+        reference's BlueStore+superblock restart-replay flow)."""
         if self.data_dir is None:
-            return None
+            return MemStore()
         import os
 
-        os.makedirs(self.data_dir, exist_ok=True)
-        return os.path.join(self.data_dir, f"osd.{self.id}.store.json")
+        from ..os.wal_store import WALStore
 
-    def _mount(self) -> MemStore:
-        import json
-        import os
-
-        path = self._checkpoint_path()
-        if path and os.path.exists(path):
-            with open(path) as f:
-                return MemStore.import_state(json.load(f))
-        return MemStore()
+        path = os.path.join(self.data_dir, f"osd.{self.id}.wal")
+        st = WALStore(path)
+        if not os.path.exists(os.path.join(path, "checkpoint")):
+            st.mkfs()
+        st.mount()
+        return st
 
     def _flush(self) -> None:
-        import json
+        from ..os.wal_store import WALStore
 
-        path = self._checkpoint_path()
-        if path:
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(self.store.export_state(), f)
-            import os
-
-            os.replace(tmp, path)  # atomic superblock swap
+        if isinstance(self.store, WALStore):
+            self.store.umount()
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:

@@ -42,6 +42,49 @@ def test_messenger_call_and_send():
         b.shutdown()
 
 
+# -- monitor boot/out semantics (unit; no daemons started) -------------------
+
+def test_boot_weight_policy():
+    """OSDMonitor::prepare_boot weight policy: an admin mark_out sticks
+    across reboot; an auto-out is undone by reboot; a known osd keeps
+    its weight; every map change gets a commit (epoch bump)."""
+    from ceph_tpu.common.context import Context
+    from ceph_tpu.crush.wrapper import CrushWrapper
+    from ceph_tpu.osdmap.osdmap import OSDMap
+    from ceph_tpu.services.monitor import Monitor
+
+    w = CrushWrapper()
+    for d in range(3):
+        w.insert_item(d, 0x10000, f"osd.{d}",
+                      {"host": f"h{d}", "root": "default"})
+    mon = Monitor(Context(), OSDMap(w.crush))
+    try:
+        mon._commit("genesis")
+        for d in range(3):
+            mon._h_boot({"osd": d, "addr": ["127.0.0.1", 7000 + d]})
+        # admin out, then reboot: weight must STAY 0
+        mon._h_mark_out({"osd": 1})
+        e = mon.map.epoch
+        mon._h_boot({"osd": 1, "addr": ["127.0.0.1", 7001]})
+        assert mon.map.osd_weight[1] == 0
+        # unchanged reboot → no epoch churn
+        mon._h_boot({"osd": 2, "addr": ["127.0.0.1", 7002]})
+        assert mon.map.epoch == e
+        # auto-out (monitor-initiated), then reboot: weight restored,
+        # and the change is committed so the stored epoch matches
+        mon.mark_down(2)
+        with mon._lock:
+            mon._auto_out[2] = mon.map.osd_weight[2]
+            mon.map.osd_weight[2] = 0
+        mon._commit("osd.2 auto-out")
+        mon._h_boot({"osd": 2, "addr": ["127.0.0.1", 7002]})
+        assert mon.map.osd_weight[2] == 0x10000
+        stored = mon.get_epoch_payload(mon.map.epoch)
+        assert stored["map"]["osd_weight"][2] == 0x10000
+    finally:
+        mon.msgr.shutdown()
+
+
 # -- cluster ------------------------------------------------------------------
 
 @pytest.fixture(scope="module")

@@ -62,6 +62,54 @@ def test_apply_removals_and_state_xor():
     assert got.to_dict() == new.to_dict()
 
 
+def test_full_upmap_primary_temp_and_pool_delete():
+    """pg_upmap (full remap), primary_temp, and pool deletions must
+    travel in deltas (OSDMap.h:382-405); a follower applying increments
+    must converge on maps that mutate them."""
+    old = make_map()
+    old.pg_upmap[(1, 7)] = [5, 4, 3]
+    old.primary_temp[(1, 9)] = 2
+    old.pools[3] = PgPool(size=2, pg_num=8, crush_rule=0)
+    new = clone(old)
+    new.epoch += 1
+    new.pg_upmap[(1, 8)] = [0, 1, 2]      # add
+    del new.pg_upmap[(1, 7)]              # remove
+    new.primary_temp[(1, 4)] = 1          # add
+    del new.primary_temp[(1, 9)]          # remove
+    del new.pools[3]                      # pool deletion
+    inc = diff_maps(old, new)
+    assert inc.new_pg_upmap[(1, 8)] == [0, 1, 2]
+    assert (1, 7) in inc.old_pg_upmap
+    assert inc.new_primary_temp[(1, 4)] == 1
+    assert inc.new_primary_temp[(1, 9)] == -1  # -1 removes
+    assert 3 in inc.old_pools
+    rt = Incremental.from_dict(inc.to_dict())  # wire round-trip
+    got = clone(old)
+    apply_incremental(got, rt)
+    assert got.to_dict() == new.to_dict()
+
+
+def test_primary_affinity_reset_to_default():
+    """new map with affinity None (all-default) after a non-default old
+    list must emit explicit default deltas, or followers keep stale
+    affinities."""
+    old = make_map()
+    old.set_primary_affinity(1, 0x8000)
+    old.set_primary_affinity(4, 0x4000)
+    new = clone(old)
+    new.epoch += 1
+    new.osd_primary_affinity = None  # reset to default
+    inc = diff_maps(old, new)
+    assert set(inc.new_primary_affinity) == {1, 4}
+    got = clone(old)
+    apply_incremental(got, inc)
+    # applying materializes an explicit all-default list; placement
+    # equivalence is what matters, compare through the accessor
+    from ceph_tpu.osdmap.osdmap import DEFAULT_PRIMARY_AFFINITY
+    assert all(a == DEFAULT_PRIMARY_AFFINITY
+               for a in got.osd_primary_affinity)
+
+
 def test_shrink_max_osd():
     """A shrink must not emit deltas for truncated osds (they'd index
     out of bounds after new_max_osd applies)."""

@@ -87,7 +87,7 @@ def test_osd_restart_remounts_data(tmp_path):
         cl.kill_osd(victim)
         assert os.path.exists(
             str(tmp_path / f"osd{victim}" /
-                f"osd.{victim}.store.json"))
+                f"osd.{victim}.wal" / "checkpoint"))
 
         svc = cl.revive_osd(victim)
         after = set()
