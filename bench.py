@@ -112,28 +112,48 @@ def _measure_crush(fn, A, weight, batch, iters):
     return batch * iters / dt, dt
 
 
-def _stage_crush(name, plat, batch, iters):
+def _stage_crush(name, plat, batch, iters, engine="xla"):
+    """One CRUSH measurement stage: build (general or speculative
+    lowering), compile+warmup, golden-validate, measure, emit."""
     import jax
     import jax.numpy as jnp
 
-    from ceph_tpu.crush.mapper_jax import build_rule_fn
-
     cmap, case = _load_case(name)
     t0 = time.perf_counter()
-    fn, static, arrays = build_rule_fn(cmap, case["ruleno"],
-                                       case["numrep"])
+    if engine == "xla-spec":
+        from ceph_tpu.crush.mapper_spec import build_spec_rule_fn
+
+        fn, static, arrays = build_spec_rule_fn(
+            cmap, case["ruleno"], case["numrep"], k_tries=1)
+    else:
+        from ceph_tpu.crush.mapper_jax import build_rule_fn
+
+        fn, static, arrays = build_rule_fn(cmap, case["ruleno"],
+                                           case["numrep"])
     A = jax.tree_util.tree_map(jnp.asarray, arrays)
     weight = jnp.asarray(case["weight_np"])
     xs = jnp.arange(batch, dtype=jnp.uint32)
     res, lens = fn(A, weight, xs)  # trace + compile + first run
     res.block_until_ready()
     compile_s = time.perf_counter() - t0
-    _golden_check(case, res, lens, f"{plat}/{name}")
+    _golden_check(case, res, lens, f"{plat}/{name}/{engine}")
     rate, dt = _measure_crush(fn, A, weight, batch, iters)
     _emit(stage="crush", map=name, rate=rate, platform=plat,
-          engine="xla", compile_s=round(compile_s, 2),
+          engine=engine, compile_s=round(compile_s, 2),
           measure_s=round(dt, 3), batch=batch, iters=iters)
     return rate
+
+
+def _try_stage(label, fn, *a, **kw):
+    """One stage must never cost the later ones — except a golden
+    mismatch, which means wrong mappings and must never be masked."""
+    try:
+        return fn(*a, **kw)
+    except AssertionError:
+        raise
+    except Exception as e:
+        print(f"# stage {label} failed: {e!r}", file=sys.stderr)
+        return None
 
 
 def worker_staged():
@@ -155,12 +175,23 @@ def worker_staged():
         # env override exercises the full staged path in tests.)
         return
     on = plat != "cpu"
-    _stage_crush("map_flat12", plat, batch=1 << 14, iters=4)
-    _stage_crush("map_big10k", plat,
-                 batch=(1 << 17) if on else (1 << 13),
-                 iters=8 if on else 2)
-    _stage_ec(plat, chunk=1 << 16, batch=4, iters=4, tag="small")
-    _stage_ec(plat, chunk=1 << 20, batch=4, iters=8, tag="large")
+    # speculative lowering first: fastest compile AND fastest measured
+    # engine, so the best-known number lands earliest (Ineligible on a
+    # non-eligible rule is caught like any stage failure)
+    _try_stage("spec/flat12", _stage_crush, "map_flat12", plat,
+               batch=1 << 14, iters=4, engine="xla-spec")
+    _try_stage("spec/big10k", _stage_crush, "map_big10k", plat,
+               batch=(1 << 16) if on else (1 << 13),
+               iters=8 if on else 3, engine="xla-spec")
+    _try_stage("gen/flat12", _stage_crush, "map_flat12", plat,
+               batch=1 << 14, iters=4)
+    _try_stage("gen/big10k", _stage_crush, "map_big10k", plat,
+               batch=(1 << 17) if on else (1 << 13),
+               iters=8 if on else 2)
+    _try_stage("ec/small", _stage_ec, plat, chunk=1 << 16, batch=4,
+               iters=4, tag="small")
+    _try_stage("ec/large", _stage_ec, plat, chunk=1 << 20, batch=4,
+               iters=8, tag="large")
 
 
 def worker_crush_cpu(batch=None, iters=None):
@@ -284,6 +315,12 @@ def _spawn(phase: str, platform: str):
     if platform == "cpu":
         env["JAX_PLATFORMS"] = "cpu"
         env["CEPH_TPU_PLATFORM"] = "cpu"
+        # the axon sitecustomize hook registers the TPU PJRT plugin in
+        # every process when this var is set, and a registered plugin is
+        # initialized by backend discovery even under JAX_PLATFORMS=cpu —
+        # hanging forever when the TPU tunnel is down.  CPU workers must
+        # never touch it.
+        env["PALLAS_AXON_POOL_IPS"] = ""
     return subprocess.Popen(
         [sys.executable, str(REPO / "bench.py"), "--worker", phase],
         env=env, stdout=subprocess.PIPE, stderr=None,
@@ -375,7 +412,20 @@ def main():
             acc = None
         else:
             acc_big = acc.wait(is_big, TPU_DEADLINE)
-            acc_tiny = acc.find(is_crush)
+            if acc_big is not None:
+                # both mapper engines (xla-spec, xla) report on the big
+                # map; give the second a bounded grace window and keep
+                # the faster figure
+                grace = min(TPU_DEADLINE,
+                            (time.perf_counter() - acc.t0) + 90)
+                acc.wait(lambda r: sum(
+                    1 for x in acc.results if is_big(x)) >= 2, grace)
+                bigs = [r for r in acc.results if is_big(r)]
+                acc_big = max(bigs, key=lambda r: r.get("rate", 0.0))
+            acc_tiny = max(
+                (r for r in acc.results
+                 if is_crush(r) and not is_big(r)),
+                key=lambda r: r.get("rate", 0.0), default=None)
             if acc_big is None and acc_tiny is None:
                 acc.kill("no crush stage within deadline")
 

@@ -62,6 +62,75 @@ def crush_ln(xin, xp=np, tables=None):
     return (iexpon << xp.uint64(12 + 32)) + lh
 
 
+_LN16_NP = None
+
+
+def ln16_table() -> np.ndarray:
+    """The full-domain crush_ln table: ``LN16[u] == crush_ln(u)`` for every
+    u in [0, 0xffff].
+
+    crush_ln's input is always ``hash & 0xffff`` (mapper.c:318), so the
+    whole normalize + reciprocal/log-table pipeline collapses to one
+    65536-entry u64 gather — 512 KiB, VMEM-resident on TPU.  Built once on
+    the host from the bit-exact crush_ln itself, so equality is by
+    construction (asserted in tests/test_ln.py).
+    """
+    global _LN16_NP
+    if _LN16_NP is None:
+        _LN16_NP = crush_ln(np.arange(65536, dtype=np.uint32), xp=np)
+    return _LN16_NP
+
+
+def recip64(weight, xp=np):
+    """Per-item reciprocals ``floor((2^64-1) / w)`` for the division-free
+    straw2 key (zero weights map to 0; they are sentineled out later).
+
+    Computed once per weight array — on the host or hoisted to the
+    unbatched prefix of a jitted program — so the per-draw cost of the
+    16.16 division in mapper.c:335 drops from a 64-bit divide per item to
+    a multiply-high.
+    """
+    w = xp.asarray(weight, dtype=xp.uint32).astype(xp.uint64)
+    wsafe = xp.where(w == 0, xp.uint64(1), w)
+    return xp.where(w == 0, xp.uint64(0),
+                    xp.uint64(0xFFFFFFFFFFFFFFFF) // wsafe)
+
+
+def straw2_key(u16, weight, recip, xp=np, ln_tab=None):
+    """Division-free straw2 selection key.
+
+    Returns ``q = (2^48 - crush_ln(u16)) // weight`` as u64, with zero
+    weights mapped to U64_MAX.  Because the reference draw is ``-q``
+    compared with strict ``>`` keeping the first maximum
+    (mapper.c:345-360), ``argmin`` over these keys (first minimum wins)
+    selects the identical item — asserted bit-exact against
+    ``straw2_draw`` in tests/test_ln.py.
+
+    The floor division is a multiply-high by the precomputed reciprocal
+    plus one correction step: with r = floor((2^64-1)/w) the estimate
+    ``mulhi64(neg, r)`` is q-1 or q (error < neg/2^64 + 1 <= 1 + eps for
+    neg < 2^48), and all correction products fit u64 since
+    q*w <= neg < 2^48.
+    """
+    tab = ln_tab if ln_tab is not None else ln16_table()
+    u = xp.asarray(u16, dtype=xp.uint32) & xp.uint32(0xFFFF)
+    ln = tab[u.astype(xp.int32)]
+    neg = xp.uint64(1 << 48) - ln
+
+    r = xp.asarray(recip, dtype=xp.uint64)
+    # mulhi64(neg, r): neg = a1*2^32 + a0 with a1 < 2^16, r = b1*2^32 + b0
+    a0 = neg & xp.uint64(0xFFFFFFFF)
+    a1 = neg >> xp.uint64(32)
+    b0 = r & xp.uint64(0xFFFFFFFF)
+    b1 = r >> xp.uint64(32)
+    mid = a0 * b1 + a1 * b0 + ((a0 * b0) >> xp.uint64(32))  # < 2^64, no wrap
+    q = a1 * b1 + (mid >> xp.uint64(32))
+    w = xp.asarray(weight, dtype=xp.uint32).astype(xp.uint64)
+    wsafe = xp.where(w == 0, xp.uint64(1), w)
+    q = q + ((q + xp.uint64(1)) * wsafe <= neg).astype(xp.uint64)
+    return xp.where(w == 0, xp.uint64(0xFFFFFFFFFFFFFFFF), q)
+
+
 def straw2_draw(u16, weight, xp=np, tables=None):
     """The signed straw2 draw: ``div64_s64(crush_ln(u16) - 2^48, weight)``.
 

@@ -33,6 +33,7 @@ descents.  The reformulation:
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import numpy as np
@@ -52,7 +53,8 @@ from jax import lax  # noqa: E402
 
 from . import constants as C  # noqa: E402
 from . import hash as H  # noqa: E402
-from .ln import LL_NP, RH_LH_NP, straw2_draw  # noqa: E402
+from .ln import (LL_NP, RH_LH_NP, ln16_table, recip64,  # noqa: E402
+                 straw2_draw, straw2_key)
 from .map import ChooseArgMap, CrushMap  # noqa: E402
 from .map_arrays import MapArrays, MapStatic, encode_map  # noqa: E402
 
@@ -97,6 +99,23 @@ class _RuleCompiler:
         self.S = static.max_size
         self.needs_perm = needs_perm
         self.tabs = (jnp.asarray(RH_LH_NP), jnp.asarray(LL_NP))
+        # The straw2 selection has two bit-identical lowerings: the
+        # arithmetic crush_ln + 64-bit divide (best on CPU, where integer
+        # division is native), and the LN16-table + reciprocal-mulhi key
+        # (best on TPU, where the divide and the ln pipeline dominate the
+        # whole mapper).  Both are golden-tested; pick per backend, with
+        # CEPH_TPU_STRAW2={table,compute} as the override.
+        mode = os.environ.get("CEPH_TPU_STRAW2", "")
+        if mode not in ("table", "compute"):
+            mode = "compute" if jax.default_backend() == "cpu" else "table"
+        self.use_table_key = mode == "table"
+        self.ln16 = jnp.asarray(ln16_table()) if self.use_table_key \
+            else None
+        # weight reciprocals for the division-free straw2 key; set per
+        # trace by single() so they are computed once per launch (they
+        # depend only on the unbatched map arrays, so vmap hoists them)
+        self.recip_w = None
+        self.recip_aw = None
 
     # -- workspace ----------------------------------------------------
     def perm_init(self):
@@ -175,9 +194,17 @@ class _RuleCompiler:
         else:
             wts = A.weights[bidx]
             ids = A.items[bidx]
-        u = _h3(hsh, x, ids, r) & jnp.uint32(0xFFFF)
-        draws = straw2_draw(u, wts, xp=jnp, tables=self.tabs)
+        u = _h3(hsh, x, ids, r)
         lane = jnp.arange(self.S, dtype=I32)
+        if self.use_table_key:
+            rec = self.recip_aw[bidx, pos] if self.st.has_choose_args \
+                else self.recip_w[bidx]
+            keys = straw2_key(u, wts, rec, xp=jnp, ln_tab=self.ln16)
+            keys = jnp.where(lane < sz, keys,
+                             jnp.uint64(0xFFFFFFFFFFFFFFFF))
+            return A.items[bidx, jnp.argmin(keys)]
+        draws = straw2_draw(u & jnp.uint32(0xFFFF), wts, xp=jnp,
+                            tables=self.tabs)
         draws = jnp.where(lane < sz, draws, jnp.int64(C.S64_MIN))
         return A.items[bidx, jnp.argmax(draws)]
 
@@ -616,6 +643,11 @@ def make_single_fn(cmap: CrushMap, ruleno: int, result_max: int,
     B = static.max_buckets
 
     def single(A, weight, x):
+        if rc.use_table_key:
+            if static.has_choose_args:
+                rc.recip_aw = recip64(A.arg_weights, xp=jnp)
+            else:
+                rc.recip_w = recip64(A.weights, xp=jnp)
         choose_tries = total_tries + 1  # mapper.c:906 off-by-one heritage
         choose_leaf_tries = 0
         local_retries = local_tries

@@ -1,0 +1,453 @@
+"""Speculative straw2 firstn mapper — the divergence-tolerant fast path.
+
+The general batched mapper (``mapper_jax.py``) reproduces the reference's
+retry descent (crush_choose_firstn, src/crush/mapper.c:438-626) as a
+per-lane ``lax.while_loop``.  Under ``vmap`` that loop runs until the
+*slowest* lane finishes and every iteration does only one small descent
+step, so the program the TPU sees is long, serial, and narrow — the exact
+shape the MXU hates.
+
+This module compiles the *common case* — straw2-only hierarchies mapped by
+a ``take / chooseleaf firstn / emit`` rule under modern tunables
+(choose_local_tries=0, choose_local_fallback_tries=0) — into a dense
+speculative program instead:
+
+- One "try" of the reference's retry loop is a pure descent from the take
+  root (r = rep + ftotal, mapper.c:497) whose depth is bounded by the
+  static hierarchy depth.  Nothing about try ``ftotal`` depends on try
+  ``ftotal-1`` *except* which one is selected, so K tries are evaluated
+  at once as (K, fanout)-shaped straw2 draws and the reference's retry
+  semantics collapse to "first non-failing try wins" (masked argmax).
+- The chooseleaf recursion (mapper.c:548-572: numrep=1, its own retry
+  budget ``recurse_tries``, r' = (stable ? 0 : outpos) + (vary_r ?
+  r >> (vary_r-1) : 0) + ftotal') is unrolled the same way: with
+  chooseleaf_descend_once (tunables since firefly) it is a single pure
+  descent per outer try.
+- The per-rep round loop remains a ``lax.while_loop``, but its body now
+  retires K tries per iteration and virtually always exits after one.
+
+Bit-exactness contract: identical (result, len) to ``mapper_ref.py`` /
+``mapper_jax.py`` for every eligible (map, rule, tunables) combination —
+asserted for all golden maps in ``tests/test_mapper_spec.py``.  Eligible
+rules are detected by :func:`analyze`; ineligible ones raise
+:class:`Ineligible` and callers fall back to the general mapper.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+import jax
+
+if not jax.config.jax_enable_x64:
+    jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from jax import lax  # noqa: E402
+
+from . import constants as C  # noqa: E402
+from . import hash as H  # noqa: E402
+from .ln import (LL_NP, RH_LH_NP, ln16_table, recip64,  # noqa: E402
+                 straw2_draw, straw2_key)
+from .map import ChooseArgMap, CrushMap  # noqa: E402
+from .map_arrays import encode_map  # noqa: E402
+
+I32 = jnp.int32
+U32 = jnp.uint32
+NONE = C.CRUSH_ITEM_NONE
+
+# per-k try status codes
+_DESC = 0     # still descending
+_OK = 1       # reached an item of the wanted type (device for inner)
+_FAIL = 2     # reject/collide/empty — costs one ftotal, retry from root
+_SKIP = 3     # terminal: give up this rep (over / unresolvable child)
+
+
+class Ineligible(ValueError):
+    """The (map, rule, tunables) combination needs the general mapper."""
+
+
+@dataclass(frozen=True)
+class Plan:
+    """Static facts the speculative compiler needs (all trace-time)."""
+
+    root_idx: int        # bucket index of the take root
+    numrep: int
+    type_: int           # target type of the choose step
+    leafy: bool          # chooseleaf (recurse to device) vs choose type 0
+    tries: int           # outer retry budget (choose_total_tries + 1 rule)
+    recurse_tries: int   # inner retry budget (1 under descend_once)
+    vary_r: int
+    stable: int
+    depth_outer: int     # max descent levels root -> anywhere
+    depth_inner: int     # max descent levels below a type_ bucket
+
+
+def _max_depth(cmap: CrushMap, idx: int, _seen=()) -> int:
+    """Longest chain of bucket hops starting at bucket index ``idx`` (a
+    descent performs one choose per hop, so this bounds any terminating
+    descent).  Maps are forests (builder/wrapper cannot create cycles);
+    a cycle would mean the C descent doesn't terminate either."""
+    b = cmap.buckets.get(idx)
+    if b is None:
+        return 0
+    if idx in _seen:
+        raise Ineligible("bucket graph has a cycle")
+    best = 1
+    for it in b.items:
+        if it < 0 and (-1 - it) in cmap.buckets:
+            best = max(best, 1 + _max_depth(cmap, -1 - it, _seen + (idx,)))
+    return best
+
+
+def analyze(cmap: CrushMap, ruleno: int, result_max: int) -> Plan:
+    """Decide eligibility and extract the static plan.
+
+    Eligible iff: every bucket is straw2; the rule is one
+    ``take`` / ``choose(leaf) firstn`` / ``emit`` block (SET_* tunable
+    steps allowed); the effective local retry knobs are 0 (modern
+    tunables — mapper.c:444-449 never takes the retry_bucket or
+    perm-fallback paths then); the inner budget unrolls (<= 4); and
+    numrep fits result_max.
+    """
+    for b in cmap.buckets.values():
+        if b.alg != C.CRUSH_BUCKET_STRAW2:
+            raise Ineligible(f"bucket alg {b.alg} != straw2")
+    t = cmap.tunables
+    rule = cmap.rules[ruleno]
+
+    choose_tries = t.choose_total_tries + 1  # mapper.c:906 heritage
+    choose_leaf_tries = 0
+    local_retries = t.choose_local_tries
+    local_fb = t.choose_local_fallback_tries
+    vary_r = t.chooseleaf_vary_r
+    stable = t.chooseleaf_stable
+
+    root = None
+    choose = None
+    emitted = False
+    for step in rule.steps:
+        op, arg1, arg2 = step.op, step.arg1, step.arg2
+        if emitted:
+            raise Ineligible("steps after emit")
+        if op == C.CRUSH_RULE_SET_CHOOSE_TRIES:
+            if arg1 > 0:
+                choose_tries = arg1
+        elif op == C.CRUSH_RULE_SET_CHOOSELEAF_TRIES:
+            if arg1 > 0:
+                choose_leaf_tries = arg1
+        elif op == C.CRUSH_RULE_SET_CHOOSE_LOCAL_TRIES:
+            if arg1 >= 0:
+                local_retries = arg1
+        elif op == C.CRUSH_RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES:
+            if arg1 >= 0:
+                local_fb = arg1
+        elif op == C.CRUSH_RULE_SET_CHOOSELEAF_VARY_R:
+            if arg1 >= 0:
+                vary_r = arg1
+        elif op == C.CRUSH_RULE_SET_CHOOSELEAF_STABLE:
+            if arg1 >= 0:
+                stable = arg1
+        elif op == C.CRUSH_RULE_TAKE:
+            if root is not None or choose is not None:
+                raise Ineligible("multiple takes")
+            if arg1 >= 0 or cmap.bucket_by_id(arg1) is None:
+                raise Ineligible("take target is not an existing bucket")
+            root = -1 - arg1
+        elif op in (C.CRUSH_RULE_CHOOSELEAF_FIRSTN, C.CRUSH_RULE_CHOOSE_FIRSTN):
+            if root is None or choose is not None:
+                raise Ineligible("choose without take / multiple chooses")
+            leafy = op == C.CRUSH_RULE_CHOOSELEAF_FIRSTN
+            numrep = arg1
+            if numrep <= 0:
+                numrep += result_max
+            if not (0 < numrep <= result_max):
+                raise Ineligible("numrep outside [1, result_max]")
+            if numrep > 16:
+                raise Ineligible("numrep unroll bound exceeded")
+            if not leafy and arg2 != 0:
+                raise Ineligible("choose firstn of a non-device type")
+            choose = (numrep, arg2, leafy)
+        elif op == C.CRUSH_RULE_EMIT:
+            if choose is None:
+                raise Ineligible("emit without choose")
+            emitted = True
+        else:
+            raise Ineligible(f"unsupported step op {op}")
+    if not emitted:
+        raise Ineligible("rule never emits")
+    if local_retries != 0 or local_fb != 0:
+        raise Ineligible("legacy local retry tunables in force")
+
+    numrep, type_, leafy = choose
+    if leafy:
+        if choose_leaf_tries:
+            recurse_tries = choose_leaf_tries
+        elif t.chooseleaf_descend_once:
+            recurse_tries = 1
+        else:
+            recurse_tries = choose_tries
+    else:
+        recurse_tries = 1
+    if recurse_tries > 4:
+        raise Ineligible(f"recurse_tries {recurse_tries} unroll bound")
+
+    depth_outer = _max_depth(cmap, root)
+    depth_inner = 1
+    if leafy and type_ > 0:
+        depths = [_max_depth(cmap, i) for i, b in cmap.buckets.items()
+                  if b.type == type_]
+        depth_inner = max(depths) if depths else 1
+    return Plan(root_idx=root, numrep=numrep, type_=type_, leafy=leafy,
+                tries=choose_tries, recurse_tries=recurse_tries,
+                vary_r=vary_r, stable=stable,
+                depth_outer=depth_outer, depth_inner=depth_inner)
+
+
+def make_single_spec(cmap: CrushMap, ruleno: int, result_max: int,
+                     choose_args: Optional[ChooseArgMap] = None,
+                     encoded=None, k_tries: int = 8):
+    """The unjitted single-x speculative program:
+    ``single(arrays, weight, x) -> (result i32[R], len i32)``.
+
+    Raises :class:`Ineligible` when the rule needs the general mapper.
+    Returns ``(single, static, arrays_np)`` like
+    ``mapper_jax.make_single_fn``.
+    """
+    plan = analyze(cmap, ruleno, result_max)
+    static, arrays_np = encoded if encoded is not None \
+        else encode_map(cmap, choose_args)
+    mode = os.environ.get("CEPH_TPU_STRAW2", "")
+    if mode not in ("table", "compute"):
+        mode = "table"  # best in this flat-shaped program on every backend
+    use_table = mode == "table"
+    ln16 = jnp.asarray(ln16_table()) if use_table else None
+    tabs = None if use_table else (jnp.asarray(RH_LH_NP),
+                                   jnp.asarray(LL_NP))
+    S = static.max_size
+    B = static.max_buckets
+    R = result_max
+    K = max(1, min(k_tries, plan.tries))
+    maxdev = static.max_devices
+    U64MAX = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+    def straw2_k(A, rw, x, cur, r, pos):
+        """straw2 choose (mapper.c:287-362) over a (K,) vector of bucket
+        indices; ``pos`` is the choose_args position (the C outpos) and
+        ``rw`` the precomputed weight reciprocals (division-free key)."""
+        if static.has_choose_args:
+            p = jnp.minimum(pos, static.max_positions - 1)
+            wts = A.arg_weights[cur, p]
+            rec = rw[cur, p] if use_table else None
+            ids = A.arg_ids[cur]
+        else:
+            wts = A.weights[cur]
+            rec = rw[cur] if use_table else None
+            ids = A.items[cur]
+        h = H.crush_hash32_3(jnp.uint32(x), ids.astype(U32),
+                             r[:, None].astype(U32))
+        h = jnp.where(A.bhash[cur][:, None] == C.CRUSH_HASH_RJENKINS1,
+                      h, jnp.uint32(0))
+        lane = jnp.arange(S, dtype=I32)
+        in_bucket = lane[None, :] < A.size[cur][:, None]
+        if use_table:
+            keys = straw2_key(h, wts, rec, xp=jnp, ln_tab=ln16)
+            keys = jnp.where(in_bucket, keys, U64MAX)
+            return A.items[cur, jnp.argmin(keys, axis=1)]
+        draws = straw2_draw(h & jnp.uint32(0xFFFF), wts, xp=jnp,
+                            tables=tabs)
+        draws = jnp.where(in_bucket, draws, jnp.int64(C.S64_MIN))
+        return A.items[cur, jnp.argmax(draws, axis=1)]
+
+    def classify(A, item):
+        is_neg = item < 0
+        cidx = jnp.clip(-1 - item, 0, B - 1)
+        exists = is_neg & ((-1 - item) < B) & (A.alg[cidx] != 0)
+        itemtype = jnp.where(is_neg, jnp.where(exists, A.btype[cidx], -1),
+                             0)
+        return itemtype, cidx, exists
+
+    def is_out(weight, item, x):
+        """mapper.c:402-416 over a (K,) item vector."""
+        wmax = weight.shape[0]
+        w = weight[jnp.clip(item, 0, wmax - 1)]
+        h = H.crush_hash32_2(jnp.uint32(x), item.astype(U32)) \
+            & jnp.uint32(0xFFFF)
+        return jnp.where(item >= wmax, True,
+                         jnp.where(w >= 0x10000, False,
+                                   jnp.where(w == 0, True, h >= w)))
+
+    def seg_any_eq(vec, n, item):
+        """any(vec[i] == item_k for i < n) -> bool (K,)."""
+        idx = jnp.arange(vec.shape[0], dtype=I32)
+        return jnp.any((idx[None, :] < n) & (vec[None, :] == item[:, None]),
+                       axis=1)
+
+    def descend(A, rw, x, start, r, pos, want_type, levels):
+        """K pure descents: from bucket indices ``start`` choose with rank
+        ``r`` per level until an item of ``want_type`` appears
+        (mapper.c:497-546 minus the retry paths analyze() ruled out).
+        Returns (status (K,), item (K,), item_bidx (K,))."""
+        cur = start
+        status = jnp.zeros((K,), I32)
+        fitem = jnp.zeros((K,), I32)
+        fcidx = jnp.zeros((K,), I32)
+        for _ in range(levels):
+            item = straw2_k(A, rw, x, cur, r, pos)
+            empty = A.size[cur] == 0
+            over = item >= maxdev
+            itemtype, cidx, exists = classify(A, item)
+            want = itemtype == want_type
+            new = jnp.where(empty, _FAIL,
+                            jnp.where(over, _SKIP,
+                                      jnp.where(want, _OK,
+                                                jnp.where(exists, _DESC,
+                                                          _SKIP))))
+            act = status == _DESC
+            fitem = jnp.where(act & (new == _OK), item, fitem)
+            fcidx = jnp.where(act & (new == _OK), cidx, fcidx)
+            cur = jnp.where(act & (new == _DESC), cidx, cur)
+            status = jnp.where(act, new, status)
+        # levels bounds every terminating descent; anything still
+        # descending would not terminate under the C semantics either
+        status = jnp.where(status == _DESC, _FAIL, status)
+        return status, fitem, fcidx
+
+    def leaf_try(A, rw, weight, x, host_idx, r_in, pos, out2, outpos):
+        """One inner try (mapper.c:548-572 recursion, numrep=1): descent
+        host->device plus the device checks.  Returns (status, dev)."""
+        st, dev, _ = descend(A, rw, x, host_idx, r_in, pos, 0,
+                             plan.depth_inner)
+        ok = st == _OK
+        bad = ok & (seg_any_eq(out2, outpos, dev)
+                    | is_out(weight, dev, x))
+        return jnp.where(bad, _FAIL, st), dev
+
+    def single(A, weight, x):
+        # weight reciprocals: unbatched under vmap (depend only on A), so
+        # they are computed once per launch, not per lane
+        rw = None
+        if use_table:
+            rw = recip64(A.arg_weights, xp=jnp) if static.has_choose_args \
+                else recip64(A.weights, xp=jnp)
+        out = jnp.full(R, NONE, I32)
+        out2 = jnp.full(R, NONE, I32)
+        outpos = jnp.int32(0)
+        ks = jnp.arange(K, dtype=I32)
+
+        for rep in range(plan.numrep):
+            def round_body(st, rep=rep):
+                ftotal, done, succ, hostv, devv = st
+                r = (rep + ftotal + ks).astype(I32)
+                ost, host, hidx = descend(A, rw, x,
+                                          jnp.full((K,), plan.root_idx,
+                                                   I32),
+                                          r, outpos, plan.type_,
+                                          plan.depth_outer)
+                found = ost == _OK
+                collide = found & seg_any_eq(out, outpos, host)
+
+                if plan.leafy and plan.type_ > 0:
+                    # chooseleaf recursion, unrolled over its try budget
+                    sub_r = (r >> (plan.vary_r - 1)) if plan.vary_r \
+                        else jnp.zeros((K,), I32)
+                    rep_in = jnp.int32(0) if plan.stable else outpos
+                    dev = jnp.zeros((K,), I32)
+                    got = jnp.zeros((K,), bool)
+                    dead = jnp.zeros((K,), bool)
+                    for j in range(plan.recurse_tries):
+                        ist, d = leaf_try(A, rw, weight, x, hidx,
+                                          (rep_in + sub_r + j).astype(I32),
+                                          outpos, out2, outpos)
+                        take = found & ~got & ~dead & (ist == _OK)
+                        dev = jnp.where(take, d, dev)
+                        got = got | take
+                        dead = dead | (~got & (ist == _SKIP))
+                    live = found & ~collide & got
+                else:
+                    # direct device choose (type 0): out-check the item
+                    dev = host
+                    live = found & ~collide & ~is_out(weight, host, x)
+
+                eff = jnp.where(found & ~live, _FAIL, ost)
+                # tries beyond the rep's remaining budget read as give-up
+                eff = jnp.where(ftotal + ks < plan.tries, eff, _SKIP)
+                pick = jnp.argmax(eff != _FAIL)
+                any_pick = jnp.any(eff != _FAIL)
+                win = any_pick & (eff[pick] == _OK)
+                return (ftotal + K, any_pick, succ | win,
+                        jnp.where(win, host[pick], hostv),
+                        jnp.where(win, dev[pick], devv))
+
+            def round_cond(st):
+                return (~st[1]) & (st[0] < plan.tries)
+
+            st = (jnp.int32(0), jnp.bool_(False), jnp.bool_(False),
+                  jnp.int32(0), jnp.int32(0))
+            _, _, succ, host, dev = lax.while_loop(round_cond, round_body,
+                                                   st)
+            slot = jnp.clip(outpos, 0, R - 1)
+            out = jnp.where(succ, out.at[slot].set(host), out)
+            out2 = jnp.where(succ, out2.at[slot].set(dev), out2)
+            outpos = outpos + succ.astype(I32)
+
+        result = out2 if plan.leafy else out
+        idx = jnp.arange(R, dtype=I32)
+        result = jnp.where(idx < outpos, result, NONE)
+        return result, outpos
+
+    return single, static, arrays_np
+
+
+def build_spec_rule_fn(cmap: CrushMap, ruleno: int, result_max: int,
+                       choose_args: Optional[ChooseArgMap] = None,
+                       encoded=None, k_tries: int = 8):
+    """Compile one eligible rule into a jitted batched speculative mapper
+    with the same signature as ``mapper_jax.build_rule_fn``."""
+    single, static, arrays_np = make_single_spec(
+        cmap, ruleno, result_max, choose_args, encoded, k_tries)
+    batched = jax.jit(jax.vmap(single, in_axes=(None, None, 0)))
+    return batched, static, arrays_np
+
+
+class SpeculativeMapper:
+    """Drop-in alternative to ``BatchedMapper`` for eligible rules.
+
+    >>> m = SpeculativeMapper(cmap)          # raises Ineligible lazily
+    >>> res, lens = m.map_batch(ruleno, xs, result_max, weight)
+    """
+
+    def __init__(self, cmap: CrushMap,
+                 choose_args: Optional[ChooseArgMap] = None,
+                 k_tries: int = 8):
+        self.cmap = cmap
+        self.choose_args = choose_args
+        self.k_tries = k_tries
+        self._cache = {}
+        self._encoded = encode_map(cmap, choose_args)
+        self._arrays = jax.tree_util.tree_map(jnp.asarray,
+                                              self._encoded[1])
+
+    def rule_fn(self, ruleno: int, result_max: int):
+        key = (ruleno, result_max)
+        if key not in self._cache:
+            fn, _, _ = build_spec_rule_fn(
+                self.cmap, ruleno, result_max, self.choose_args,
+                encoded=self._encoded, k_tries=self.k_tries)
+            self._cache[key] = fn
+        return self._cache[key]
+
+    @property
+    def arrays(self):
+        return self._arrays
+
+    def map_batch(self, ruleno: int, xs, result_max: int, weight):
+        fn = self.rule_fn(ruleno, result_max)
+        xs = jnp.asarray(np.asarray(xs, np.uint32))
+        weight = jnp.asarray(np.asarray(weight, np.uint32))
+        return fn(self._arrays, weight, xs)

@@ -51,6 +51,17 @@ class MemStore(ObjectStore):
 
     # -- transaction application --------------------------------------
     def queue_transaction(self, txn: Transaction) -> None:
+        with self._lock:  # RLock: spans prepare AND commit — atomic
+            self.prepare_transaction(txn)()
+
+    def prepare_transaction(self, txn: Transaction):
+        """Validate and stage a transaction without committing it;
+        returns a cannot-fail commit callable that swaps the staged
+        state in.  WAL stores journal between the two, so a journaled
+        record is always applicable and a failed validation never
+        journals.  The caller is responsible for serializing
+        prepare→commit windows (WALStore holds its own lock across
+        both); interleaved prepares would lose updates."""
         with self._lock:
             # lazy copy-on-touch: only the top-level dict is copied up
             # front; a collection's object dict is copied the first
@@ -60,7 +71,12 @@ class MemStore(ObjectStore):
             copied: set = set()
             for op in txn.ops:
                 self._apply(staged, copied, op)
-            self._coll = staged
+
+        def commit():
+            with self._lock:
+                self._coll = staged
+
+        return commit
 
     @staticmethod
     def _coll_for_write(staged, copied, cid: str):

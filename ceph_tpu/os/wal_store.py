@@ -5,10 +5,11 @@ src/os/ObjectStore.h atomicity contract), re-shaped for this framework:
 state lives in RAM (a MemStore twin — the OSD working set), durability
 comes from a write-ahead log plus periodic checkpoints:
 
-  queue_transaction:  apply in-memory (atomic copy-swap — an invalid
+  queue_transaction:  encode + stage in-memory (validation — an invalid
                       txn never journals) → append WAL record → fsync
-                      → return (the ack point: a returned transaction
-                      is durable)
+                      (the ack point) → swap staged state visible
+                      (cannot fail, so memory never diverges from the
+                      journal even on ENOSPC/EIO mid-append)
   checkpoint:         snapshot full state to a temp file → fsync →
                       atomic rename over ``checkpoint`` → truncate WAL
   mount:              load newest valid checkpoint, replay WAL records
@@ -100,22 +101,57 @@ class WALStore(ObjectStore):
     def queue_transaction(self, txn: Transaction) -> None:
         with self._lock:
             assert self._wal_f is not None, "not mounted"
-            # 1. validate + apply in memory (atomic: all ops or none)
-            self._mem.queue_transaction(txn)
-            # 2. journal; the fsync below is the ack point
-            self._seq += 1
+            # 1. encode (an unencodable txn never journals) and
+            #    validate + stage in memory (atomic: all ops or none;
+            #    nothing visible yet)
             enc = Encoder()
             encode_txn(txn.ops, enc)
             payload = enc.bytes()
-            rec = _HDR.pack(_MAGIC, self._seq, len(payload),
+            commit = self._mem.prepare_transaction(txn)
+            # 2. journal; the fsync below is the ack point.  Journal
+            #    BEFORE the visible swap: if the append fails (ENOSPC,
+            #    EIO) the store state still equals the journal, and if
+            #    we crash right after the fsync the replay applies the
+            #    exact staged ops.
+            seq = self._seq + 1
+            rec = _HDR.pack(_MAGIC, seq, len(payload),
                             _crc32c(payload)) + payload
-            self._wal_f.write(rec)
-            self._wal_f.flush()
-            if self._sync:
-                os.fsync(self._wal_f.fileno())
+            try:
+                self._wal_f.write(rec)
+                self._wal_f.flush()
+                if self._sync:
+                    os.fsync(self._wal_f.fileno())
+            except Exception:
+                # the append may have partially landed (buffered bytes,
+                # EIO mid-fsync).  Roll the log back to the last valid
+                # record boundary so the failed txn can never replay and
+                # later records are never stranded behind torn bytes;
+                # if even that fails, poison the store (unmounted).
+                self._rollback_wal()
+                raise
+            # 3. the durable record exists: swap state in (cannot fail)
+            self._seq = seq
+            commit()
             self._wal_bytes += len(rec)
             if self._wal_bytes >= self._ckpt_every:
                 self.checkpoint()
+
+    def _rollback_wal(self) -> None:
+        """Truncate the log back to ``_wal_bytes`` (the end of the last
+        acked record) after a failed append — the runtime twin of
+        mount()'s torn-tail cut."""
+        try:
+            try:
+                self._wal_f.close()
+            except Exception:
+                pass
+            with open(self._wal_path, "r+b") as f:
+                f.truncate(self._wal_bytes)
+                f.flush()
+                os.fsync(f.fileno())
+            self._wal_f = open(self._wal_path, "ab")
+        except Exception:
+            self._wal_f = None  # poisoned: every later op asserts
 
     # -- checkpointing ------------------------------------------------
     def checkpoint(self) -> None:

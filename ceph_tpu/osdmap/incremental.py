@@ -36,8 +36,12 @@ def _unkv(rows):
 class Incremental(Versioned):
     """The delta from ``epoch - 1`` to ``epoch``."""
 
-    STRUCT_V = 1
-    COMPAT_V = 1
+    # v2: added pg_upmap / primary_temp / pool-deletion deltas.  They
+    # affect placement, so a v1 reader cannot safely skip them —
+    # COMPAT_V rises with STRUCT_V and old followers refuse the delta
+    # (and fall back to a full-map fetch) instead of silently diverging.
+    STRUCT_V = 2
+    COMPAT_V = 2
 
     epoch: int = 0
     new_max_osd: Optional[int] = None

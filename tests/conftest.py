@@ -24,6 +24,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# The axon TPU PJRT plugin is registered into EVERY python process by a
+# sitecustomize hook (gated on PALLAS_AXON_POOL_IPS), and a *registered*
+# plugin is initialized by backend discovery even under
+# JAX_PLATFORMS=cpu — which blocks forever whenever the TPU tunnel is
+# down.  Tests are CPU-only by design, so drop the factory before any
+# backend client exists.  (An execve re-exec would also work but loses
+# pytest's fd-level capture — the report would vanish.)
+try:  # noqa: SIM105 — private API; harmless if it moves
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 GOLDEN_DIR = pathlib.Path(__file__).resolve().parent / "golden"

@@ -68,3 +68,35 @@ def test_straw2_draw_scalar_ref():
     assert _straw2_draw(0, 1, 2, 0, 0) == -(2**63)
     d = _straw2_draw(0, 1, 2, 0, 0x10000)
     assert -(2**48) <= d <= 0
+
+
+def test_ln16_table_matches_crush_ln():
+    tab = LN.ln16_table()
+    np.testing.assert_array_equal(
+        tab, LN.crush_ln(np.arange(0x10000, dtype=np.uint32)))
+
+
+def test_straw2_key_selects_identically_to_draw():
+    """The division-free key must order every (u, w) pair exactly like the
+    reference draw: argmin(key) == first-argmax(draw), including zero
+    weights, w=1, saturated weights, and the neg extremes."""
+    rng = np.random.default_rng(7)
+    u = rng.integers(0, 0x10000, size=4096).astype(np.uint32)
+    w = rng.integers(0, 1 << 32, size=4096, dtype=np.uint64).astype(np.uint32)
+    w[::13] = 0
+    w[1::13] = 1
+    w[2::13] = 0x10000
+    w[3::13] = 0xFFFFFFFF
+    u[::29] = 0xFFFF   # ln = 2^48 -> neg = 0
+    u[1::29] = 0       # smallest ln -> largest neg
+    rec = LN.recip64(w)
+    key = LN.straw2_key(u, w, rec)
+    draw = LN.straw2_draw(u, w)
+    # exact q equality where w > 0
+    nz = w > 0
+    np.testing.assert_array_equal(key[nz].astype(np.int64), -draw[nz])
+    assert (key[~nz] == np.uint64(0xFFFFFFFFFFFFFFFF)).all()
+    # selection equivalence over random rows
+    for row in range(64):
+        sl = slice(row * 64, row * 64 + 64)
+        assert int(np.argmin(key[sl])) == int(np.argmax(draw[sl]))
