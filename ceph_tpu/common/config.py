@@ -70,6 +70,11 @@ OPTIONS: Dict[str, Option] = _opts(
            "jerasure isa lrc shec clay", "plugins loaded at start"),
     Option("mon_max_map_epochs", int, 500,
            "full OSDMap epochs retained by the map store"),
+    Option("mon_lease", float, 0.6,
+           "quorum leader lease interval; peons call an election "
+           "after 3 missed leases"),
+    Option("mon_election_timeout", float, 0.8,
+           "base retry window for monitor elections (rank-staggered)"),
     Option("bench_tpu_deadline", float, 300.0,
            "seconds before the bench abandons a hung backend"),
 )

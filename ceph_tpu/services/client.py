@@ -45,7 +45,7 @@ class Client(MapFollower):
     def __init__(self, name: str, mon_addr: Addr,
                  host: str = "127.0.0.1", keyring=None):
         self.name = name
-        self.mon_addr = tuple(mon_addr)
+        self._init_mons(mon_addr)  # one addr or the quorum list
         self.msgr = Messenger(f"client.{name}", host, 0,
                               keyring=keyring)
         self.msgr.register("map_update", self._h_map_update)
@@ -57,11 +57,7 @@ class Client(MapFollower):
         self.ec_profiles: Dict[str, Dict[str, str]] = {}
         self._codes: Dict[str, object] = {}
         self._lock = threading.RLock()
-        payload = self.msgr.call(self.mon_addr,
-                                 {"type": "subscribe",
-                                  "name": f"client.{name}",
-                                  "addr": list(self.msgr.addr)})
-        self._install_map(payload)
+        self._install_map(self.subscribe_all(f"client.{name}"))
 
     def shutdown(self) -> None:
         self.msgr.shutdown()
@@ -72,8 +68,7 @@ class Client(MapFollower):
         return None
 
     def refresh_map(self) -> None:
-        self._install_map(self.msgr.call(self.mon_addr,
-                                         {"type": "get_map"}))
+        self._install_map(self.mon_call({"type": "get_map"}))
 
     def _code_for(self, pool):
         if pool.pool_type != POOL_TYPE_ERASURE:

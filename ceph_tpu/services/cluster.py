@@ -28,7 +28,7 @@ from .osd_service import OSDService
 class MiniCluster:
     def __init__(self, n_osds: int = 4, hosts: Optional[int] = None,
                  config: Optional[Config] = None, auth: bool = False,
-                 data_dir: Optional[str] = None):
+                 data_dir: Optional[str] = None, n_mons: int = 1):
         self.conf = config or Config()
         # the out-of-band keyring every daemon/client shares (cephx)
         from ..msg.auth import Keyring
@@ -50,21 +50,41 @@ class MiniCluster:
             "ec_rule", "default", "host", "", "indep", rule_type=3)
 
         osdmap = OSDMap(self.wrapper.crush)
-        self.mon_ctx = Context("mon", config=self.conf)
-        mon_store = None
-        if data_dir is not None:
-            import os
-
-            mon_store = os.path.join(data_dir, "mon")
-        self.mon = Monitor(self.mon_ctx, osdmap,
-                           keyring=self.keyring,
-                           store_dir=mon_store)
+        self.n_mons = n_mons
+        self.mons: Dict[int, Monitor] = {}
+        self._mon_osdmap = osdmap
+        for rank in range(n_mons):
+            self.mons[rank] = self._make_mon(rank)
+        self.mon_addrs = [self.mons[r].addr for r in range(n_mons)]
+        if n_mons > 1:
+            for rank, mon in self.mons.items():
+                mon.set_peers(rank, self.mon_addrs)
         self.osds: Dict[int, OSDService] = {}
         self.clients: List[Client] = []
 
+    @property
+    def mon(self) -> Monitor:
+        """Historical single-mon handle: the lowest-ranked LIVE monitor
+        (a plain attribute would go stale after kill_mon/revive_mon)."""
+        return self.mons[min(self.mons)]
+
+    def _make_mon(self, rank: int, port: int = 0) -> Monitor:
+        mon_store = None
+        if self.data_dir is not None:
+            import os
+
+            mon_store = os.path.join(self.data_dir, f"mon{rank}")
+        ctx = Context(f"mon.{rank}", config=self.conf)
+        return Monitor(ctx, OSDMap.from_dict(
+            self._mon_osdmap.to_dict()), keyring=self.keyring,
+            store_dir=mon_store, port=port)
+
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "MiniCluster":
-        self.mon.start()
+        for mon in self.mons.values():
+            mon.start()
+        if self.n_mons > 1:
+            self.wait_for_quorum()
         for d in range(self.n_osds):
             self.revive_osd(d)
         return self
@@ -74,17 +94,58 @@ class MiniCluster:
             c.shutdown()
         for svc in list(self.osds.values()):
             svc.shutdown()
-        self.mon.shutdown()
+        for mon in self.mons.values():
+            mon.shutdown()
 
     def client(self, name: str = "admin") -> Client:
-        c = Client(name, self.mon.addr, keyring=self.keyring)
+        c = Client(name, self.mon_addrs, keyring=self.keyring)
         self.clients.append(c)
         return c
+
+    # -- monitor quorum hooks -------------------------------------------
+    def leader(self) -> Optional[Monitor]:
+        for mon in self.mons.values():
+            if mon.quorum is None or mon.quorum.is_leader():
+                return mon
+        return None
+
+    def wait_for_quorum(self, timeout: float = 15.0) -> Monitor:
+        end = time.monotonic() + timeout
+        while time.monotonic() < end:
+            ldr = self.leader()
+            if ldr is not None and ldr.last_committed() > 0:
+                return ldr
+            time.sleep(0.1)
+        raise TimeoutError("no monitor quorum")
+
+    def kill_mon(self, rank: int) -> None:
+        mon = self.mons.pop(rank, None)
+        if mon is not None:
+            mon.shutdown()
+
+    def revive_mon(self, rank: int) -> Monitor:
+        # rebind the original rank port so peers and daemons reach it
+        # at the address already in their quorum lists
+        mon = self._make_mon(rank, port=self.mon_addrs[rank][1])
+        if self.n_mons > 1:
+            mon.set_peers(rank, self.mon_addrs)
+        mon.start()
+        self.mons[rank] = mon
+        return mon
+
+    def mon_command(self, msg: Dict, timeout: float = 10.0) -> Dict:
+        """Send a command to the quorum via the shared failover loop."""
+        from .map_follower import failover_call
+
+        mons = list(self.mons.values())
+        rep, _ = failover_call(mons[0].msgr, [m.addr for m in mons],
+                               msg, timeout=timeout)
+        return rep
 
     # -- pool / profile management (mon command surface) ---------------
     def create_replicated_pool(self, pool_id: int, pg_num: int = 8,
                                size: int = 3) -> None:
-        self.mon.msgr.call(self.mon.addr, {
+        self.mon_command({
             "type": "pool_create", "pool_id": pool_id,
             "pool": {"pool_type": POOL_TYPE_REPLICATED, "size": size,
                      "min_size": max(1, size - 1), "pg_num": pg_num,
@@ -93,13 +154,13 @@ class MiniCluster:
     def create_ec_pool(self, pool_id: int, profile_name: str,
                        profile: Dict[str, str],
                        pg_num: int = 8) -> None:
-        self.mon.msgr.call(self.mon.addr, {
+        self.mon_command({
             "type": "ec_profile_set", "name": profile_name,
             "profile": profile})
         from ..ec.registry import profile_factory
 
         code = profile_factory(dict(profile))
-        self.mon.msgr.call(self.mon.addr, {
+        self.mon_command({
             "type": "pool_create", "pool_id": pool_id,
             "pool": {"pool_type": POOL_TYPE_ERASURE,
                      "size": code.get_chunk_count(),
@@ -110,8 +171,7 @@ class MiniCluster:
     def scrub(self, pool_id: int) -> Dict[int, list]:
         """Deep-scrub every PG of a pool on every up OSD; returns
         {osd: [inconsistent shard names]} (non-empty = damage)."""
-        payload = self.mon.msgr.call(self.mon.addr,
-                                     {"type": "get_map"})
+        payload = self.mon_command({"type": "get_map"})
         m = OSDMap.from_dict(payload["map"])
         pool = m.pools[pool_id]
         bad: Dict[int, list] = {}
@@ -152,14 +212,14 @@ class MiniCluster:
             import os
 
             data_dir = os.path.join(self.data_dir, f"osd{osd}")
-        svc = OSDService(ctx, osd, self.mon.addr,
+        svc = OSDService(ctx, osd, self.mon_addrs,
                          keyring=self.keyring, data_dir=data_dir)
         svc.start()
         self.osds[osd] = svc
         return svc
 
     def status(self) -> Dict:
-        return self.mon.msgr.call(self.mon.addr, {"type": "status"})
+        return self.mon_command({"type": "status"})
 
     def wait_for_down(self, osd: int, timeout: float = 15.0) -> None:
         self._wait(lambda: osd not in self.status()["up_osds"],
@@ -174,8 +234,7 @@ class MiniCluster:
         """wait_for_clean: every up-set shard of every object present
         on the OSD that should hold it."""
         def clean() -> bool:
-            payload = self.mon.msgr.call(self.mon.addr,
-                                         {"type": "get_map"})
+            payload = self.mon_command({"type": "get_map"})
             m = OSDMap.from_dict(payload["map"])
             pool = m.pools[pool_id]
             from .client import object_to_ps

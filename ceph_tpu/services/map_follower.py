@@ -16,13 +16,96 @@ under the lock).
 
 from __future__ import annotations
 
+import time
 from typing import Dict
 
 from ..osdmap.incremental import Incremental, apply_incremental
 from ..osdmap.osdmap import OSDMap
 
 
+class MonError(RuntimeError):
+    """Transient quorum condition (no leader yet / pre-genesis) — the
+    caller should retry; never used for map-application defects."""
+
+
+def failover_call(msgr, addrs, msg: Dict, timeout: float = 5.0,
+                  tries: int = 3):
+    """Call a monitor, rotating across the quorum: connection errors
+    move to the next member; 'no quorum' / pre-genesis replies back
+    off briefly for the election in flight.  Returns (reply, addr) so
+    callers can remember the member that answered.  Shared by daemon
+    followers (mon_call) and the MiniCluster harness (mon_command)."""
+    last: Exception = MonError("no monitors configured")
+    n = max(1, len(addrs))
+    for i in range(max(1, tries) * n):
+        addr = addrs[i % n]
+        try:
+            rep = msgr.call(addr, msg, timeout=timeout)
+        except (OSError, TimeoutError) as e:
+            last = e
+            continue
+        err = rep.get("error") if isinstance(rep, dict) else None
+        if err in ("no quorum", "no committed map yet"):
+            last = MonError(err)
+            time.sleep(0.25)
+            continue
+        return rep, tuple(addr)
+    raise last
+
+
 class MapFollower:
+    # -- monitor targets (quorum-aware MonClient) ----------------------
+    def _init_mons(self, mon_addr) -> None:
+        """Accept one monitor address or a rank-ordered list of them;
+        ``self.mon_addr`` is the currently preferred target and
+        rotates on failure."""
+        if mon_addr and isinstance(mon_addr[0], (list, tuple)):
+            self.mon_addrs = [tuple(a) for a in mon_addr]
+        else:
+            self.mon_addrs = [tuple(mon_addr)]
+        self.mon_addr = self.mon_addrs[0]
+
+    def mon_call(self, msg: Dict, timeout: float = 5.0,
+                 tries: int = 3) -> Dict:
+        i = self.mon_addrs.index(self.mon_addr)
+        order = self.mon_addrs[i:] + self.mon_addrs[:i]
+        rep, used = failover_call(self.msgr, order, msg, timeout,
+                                  tries)
+        self.mon_addr = used
+        return rep
+
+    def mon_send(self, msg: Dict) -> None:
+        """Fire-and-forget to every quorum member: peons forward or
+        drop; send() swallows dead-peer errors, so pinning one target
+        could silently blackhole (e.g. a down OSD's re-boot)."""
+        for addr in self.mon_addrs:
+            self.msgr.send(addr, msg)
+
+    def subscribe_all(self, name: str, timeout: float = 15.0) -> Dict:
+        """Subscribe to EVERY quorum member (each pushes committed
+        epochs, so losing one monitor loses no updates) and return the
+        newest committed payload; retries through elections."""
+        deadline = time.monotonic() + timeout
+        while True:
+            payload = None
+            for addr in self.mon_addrs:
+                try:
+                    rep = self.msgr.call(
+                        addr, {"type": "subscribe", "name": name,
+                               "addr": list(self.msgr.addr)},
+                        timeout=3.0)
+                except (OSError, TimeoutError):
+                    continue
+                if isinstance(rep, dict) and "epoch" in rep:
+                    if payload is None or rep["epoch"] > \
+                            payload["epoch"]:
+                        payload = rep
+            if payload is not None:
+                return payload
+            if time.monotonic() >= deadline:
+                raise TimeoutError(f"{name}: no committed map from "
+                                   f"any monitor")
+            time.sleep(0.25)
     def _set_extras(self, msg: Dict) -> None:
         """osd address table + EC profiles travel beside the map
         (call under self._lock)."""
@@ -70,21 +153,19 @@ class MapFollower:
         history.  Best-effort: the monitor re-pushes on every commit."""
         try:
             while self.epoch < target and self.map is not None:
-                got = self.msgr.call(
-                    self.mon_addr,
+                got = self.mon_call(
                     {"type": "get_inc", "epoch": self.epoch + 1},
                     timeout=5)
                 inc_d = got.get("inc")
                 if inc_d is None or not self._apply_one_inc(
                         Incremental.from_dict(inc_d)):
-                    self._install_map(self.msgr.call(
-                        self.mon_addr, {"type": "get_map"},
-                        timeout=5))
+                    self._install_map(self.mon_call(
+                        {"type": "get_map"}, timeout=5))
                     return
             with self._lock:
                 self._set_extras(msg)
             self._post_map_install()
-        except (TimeoutError, OSError):
+        except (TimeoutError, OSError, MonError):
             pass  # the next push catches us up
 
     def _post_map_install(self) -> None:  # pragma: no cover - hook

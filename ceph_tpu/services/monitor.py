@@ -1,24 +1,28 @@
 """Monitor — the cluster-map authority and failure detector.
 
-The role of src/mon (Monitor.cc / OSDMonitor.cc / MonitorDBStore.h),
-single-instance: it owns the OSDMap, bumps epochs on every state
-change, retains full maps per epoch (the MonitorDBStore analogue — any
-daemon can resume at any epoch), tracks osd boot/heartbeat liveness,
-and marks osds down after ``osd_heartbeat_grace`` without a beat
-(OSD::handle_osd_ping → OSDMonitor flow, src/osd/OSD.cc:5487 /
-ceph_osd.cc:544).  Map changes push to subscribers (MonClient
-subscription role).
+The role of src/mon (Monitor.cc / OSDMonitor.cc / MonitorDBStore.h):
+it owns the OSDMap, bumps epochs on every state change, retains full
+maps per epoch (the MonitorDBStore analogue — any daemon can resume at
+any epoch), tracks osd boot/heartbeat liveness, and marks osds down
+after ``osd_heartbeat_grace`` without a beat (OSD::handle_osd_ping →
+OSDMonitor flow, src/osd/OSD.cc:5487 / ceph_osd.cc:544).  Map changes
+push to subscribers (MonClient subscription role) through per-peer
+queues so one hung subscriber can never stall the commit path.
 
-Paxos is consciously replaced by the single authority: the reference
-runs 3+ mons for its OWN availability; the map semantics downstream
-(epochs, incremental catch-up, subscriptions) are what the rest of the
-system consumes and are preserved here.  (SURVEY §2.5 Monitor row.)
+Runs standalone (a single authority) or as one of N quorum members:
+``set_peers(rank, addrs)`` before ``start()`` attaches the election +
+replicated-log layer (services/quorum.py — the ElectionLogic/Paxos
+role).  In quorum mode every epoch is majority-replicated before it
+becomes visible, write commands are forwarded to the leader, reads and
+subscriptions are served by any member, and only the leader runs
+failure detection.  (SURVEY §2.5 Monitor row.)
 """
 
 from __future__ import annotations
 
 import json
 import os
+import queue
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -26,6 +30,7 @@ from typing import Dict, List, Optional, Tuple
 from ..common.context import Context
 from ..msg.messenger import Addr, Messenger
 from ..osdmap.osdmap import OSDMap, PgPool
+from .quorum import Quorum
 
 
 class Monitor:
@@ -50,57 +55,181 @@ class Monitor:
         # restored on boot, unlike an admin mark_out which sticks
         self._auto_out: Dict[int, int] = {}
         self._subscribers: Dict[str, Addr] = {}
+        self._pushers: Dict[str, "_SubPusher"] = {}
         self._lock = threading.RLock()
+        self._commit_serial = threading.Lock()
+        self._committed_epoch = 0
         self._ticker: Optional[threading.Thread] = None
         self._running = False
+        self.quorum: Optional[Quorum] = None
         self.ec_profiles: Dict[str, Dict[str, str]] = {}
         self.pc = ctx.perf.create("mon")
         self.pc.add_u64_counter("epochs")
         self.pc.add_u64_counter("beats")
         self.pc.add_u64_counter("markdowns")
 
-        for t, h in (("boot", self._h_boot),
-                     ("heartbeat", self._h_heartbeat),
+        # write commands mutate the map: leader-only in quorum mode
+        # (forwarded there); reads are served by any member
+        for t, h in (("boot", self._fwd(self._h_boot)),
+                     ("heartbeat", self._fwd(self._h_heartbeat,
+                                             fire_forget=True)),
                      ("get_map", self._h_get_map),
                      ("get_inc", self._h_get_inc),
                      ("subscribe", self._h_subscribe),
-                     ("mark_down", self._h_mark_down),
-                     ("mark_out", self._h_mark_out),
-                     ("pool_create", self._h_pool_create),
-                     ("ec_profile_set", self._h_ec_profile_set),
+                     ("mark_down", self._fwd(self._h_mark_down)),
+                     ("mark_out", self._fwd(self._h_mark_out)),
+                     ("pool_create", self._fwd(self._h_pool_create)),
+                     ("ec_profile_set",
+                      self._fwd(self._h_ec_profile_set)),
                      ("status", self._h_status)):
             self.msgr.register(t, h)
 
+    # -- quorum ---------------------------------------------------------
+    def set_peers(self, rank: int, addrs: List[Addr]) -> None:
+        """Join an N-monitor quorum (call before start()).  ``addrs``
+        is the rank-ordered list of every member including self."""
+        self.quorum = Quorum(
+            self, rank, addrs,
+            lease=self.ctx.conf["mon_lease"],
+            election_timeout=self.ctx.conf["mon_election_timeout"])
+
+    def _fwd(self, handler, fire_forget: bool = False):
+        """Leader-only write handler: executed locally on the leader,
+        forwarded to it from peons (Monitor::forward_request role)."""
+
+        def h(msg: Dict):
+            q = self.quorum
+            if q is None or q.is_leader():
+                return handler(msg)
+            la = q.leader_addr()
+            if la is None:
+                return {"error": "no quorum"}
+            fwd = {k: v for k, v in msg.items()
+                   if k not in ("tid", "mac", "frm")}
+            if fire_forget:
+                self.msgr.send(la, fwd)
+                return None
+            return self.msgr.call(la, fwd, timeout=5.0)
+
+        return h
+
+    def last_committed(self) -> int:
+        with self._lock:
+            return self._committed_epoch
+
+    def committed_entries(self, frm: int, to: int) -> List[Dict]:
+        """Committed (version, entry) rows in (frm, to] that are still
+        retained — the quorum catch-up feed.  (A member further behind
+        than the retention window cannot catch up incrementally; with
+        mon_max_map_epochs=500 that does not happen in practice.)"""
+        out = []
+        with self._lock:
+            for v in range(frm + 1, to + 1):
+                pay = self._epochs.get(v)
+                if pay is None:
+                    continue
+                out.append({"v": v,
+                            "entry": {"payload": pay,
+                                      "inc": self._incs.get(v)}})
+        return out
+
+    def apply_committed(self, v: int, entry: Dict) -> None:
+        """Install a majority-committed epoch (peon apply / leader
+        sync): replace live state from the full payload, store, push."""
+        p = json.loads(entry["payload"])
+        with self._lock:
+            self.map = OSDMap.from_dict(p["map"])
+            self._osd_addrs = {int(k): tuple(a)
+                               for k, a in p["osd_addrs"].items()}
+            self.ec_profiles = dict(p["ec_profiles"])
+        self._store_committed(v, entry["payload"], entry.get("inc"))
+        self.pc.inc("epochs")
+        self._push_maps()
+
+    def on_leader(self, uncommitted: Optional[Dict]) -> None:
+        """Quorum callback after winning + syncing an election."""
+        with self._lock:
+            # surviving osds get a full grace window to re-beat before
+            # the new leader may mark them down
+            now = time.monotonic()
+            for o in range(self.map.max_osd):
+                if self.map.exists(o) and self.map.is_up(o):
+                    self._last_beat.setdefault(o, now)
+        if uncommitted is not None and \
+                int(uncommitted["v"]) == self.last_committed() + 1:
+            # Paxos re-propose: an accepted-but-uncommitted entry that
+            # may have reached a majority must survive the failover
+            v = int(uncommitted["v"])
+            if self.quorum.replicate(v, uncommitted["entry"]):
+                self.apply_committed(v, uncommitted["entry"])
+        if self.last_committed() == 0:
+            try:
+                self._commit("genesis")
+            except RuntimeError:
+                pass  # lost quorum immediately; next leader retries
+
     # -- lifecycle ------------------------------------------------------
     def start(self) -> None:
-        self._commit("genesis")
         self.msgr.start()
         self._running = True
         self._ticker = threading.Thread(target=self._tick_loop,
                                         daemon=True, name="mon-tick")
         self._ticker.start()
+        if self.quorum is not None:
+            self.quorum.start()
+        else:
+            self._commit("genesis")
 
     def shutdown(self) -> None:
         self._running = False
+        if self.quorum is not None:
+            self.quorum.shutdown()
         if self._ticker:
             self._ticker.join(timeout=2)
+        for p in self._pushers.values():
+            p.stop()
         self.msgr.shutdown()
 
     # -- the epoch store (MonitorDBStore role) --------------------------
     def _commit(self, why: str) -> int:
         """Bump the epoch, retain the full map AND its delta, persist,
-        notify."""
+        notify.  In quorum mode the entry is majority-replicated BEFORE
+        it is stored or pushed anywhere; a leader that cannot reach a
+        majority rolls back and abdicates, so epochs never fork."""
         from ..osdmap.incremental import diff_maps
 
+        with self._commit_serial:
+            with self._lock:
+                self.map.epoch += 1
+                v = self.map.epoch
+                payload = json.dumps(self._map_payload())
+                inc_d = None
+                if self._prev_map is not None:
+                    inc = diff_maps(self._prev_map, self.map)
+                    inc.epoch = v
+                    inc_d = inc.to_dict()
+            if self.quorum is not None:
+                if not self.quorum.replicate(
+                        v, {"payload": payload, "inc": inc_d}):
+                    self._restore_committed()
+                    self.quorum.abdicate()
+                    raise RuntimeError(
+                        "mon: lost quorum; commit aborted")
+            self._store_committed(v, payload, inc_d)
+        self.pc.inc("epochs")
+        self.log.dout(5, f"new epoch {v} ({why})")
+        self._push_maps()
+        return v
+
+    def _store_committed(self, v: int, payload: str,
+                         inc_d: Optional[Dict]) -> None:
         with self._lock:
-            self.map.epoch += 1
-            payload = json.dumps(self._map_payload())
-            self._epochs[self.map.epoch] = payload
-            if self._prev_map is not None:
-                inc = diff_maps(self._prev_map, self.map)
-                inc.epoch = self.map.epoch
-                self._incs[self.map.epoch] = inc.to_dict()
-            self._prev_map = OSDMap.from_dict(self.map.to_dict())
+            self._epochs[v] = payload
+            if inc_d is not None:
+                self._incs[v] = inc_d
+            self._prev_map = OSDMap.from_dict(
+                json.loads(payload)["map"])
+            self._committed_epoch = v
             keep = self.ctx.conf["mon_max_map_epochs"]
             for e in sorted(self._epochs)[:-keep]:
                 del self._epochs[e]
@@ -108,14 +237,21 @@ class Monitor:
             if self.store_dir:
                 os.makedirs(self.store_dir, exist_ok=True)
                 with open(os.path.join(
-                        self.store_dir,
-                        f"osdmap.{self.map.epoch}.json"), "w") as f:
+                        self.store_dir, f"osdmap.{v}.json"), "w") as f:
                     f.write(payload)
-            epoch = self.map.epoch
-        self.pc.inc("epochs")
-        self.log.dout(5, f"new epoch {epoch} ({why})")
-        self._push_maps()
-        return epoch
+
+    def _restore_committed(self) -> None:
+        """Roll live state back to the last committed entry (a failed
+        quorum replication left only in-memory mutations)."""
+        with self._lock:
+            if self._committed_epoch == 0:
+                self.map.epoch = 0
+                return
+            p = json.loads(self._epochs[self._committed_epoch])
+            self.map = OSDMap.from_dict(p["map"])
+            self._osd_addrs = {int(k): tuple(a)
+                               for k, a in p["osd_addrs"].items()}
+            self.ec_profiles = dict(p["ec_profiles"])
 
     def _map_payload(self) -> Dict:
         return {"epoch": self.map.epoch,
@@ -130,22 +266,27 @@ class Monitor:
         return json.loads(raw) if raw else None
 
     def _push_maps(self) -> None:
+        """Queue the newest committed epoch to every subscriber.  Each
+        subscriber has its own pusher thread + bounded queue, so a hung
+        or slow peer delays only itself, never the commit path (the
+        round-3 review's push-isolation gap)."""
         with self._lock:
-            epoch = self.map.epoch
+            epoch = self._committed_epoch
+            if epoch == 0:
+                return
             inc = self._incs.get(epoch)
             payload = None if inc is not None else \
                 json.loads(self._epochs[epoch])
             extras = {"osd_addrs": {str(k): list(v) for k, v in
                                     self._osd_addrs.items()},
                       "ec_profiles": dict(self.ec_profiles)}
-            subs = list(self._subscribers.values())
-        for addr in subs:
-            if inc is not None:
-                self.msgr.send(addr, {"type": "map_inc", "inc": inc,
-                                      **extras})
-            else:
-                self.msgr.send(addr, {"type": "map_update",
-                                      "payload": payload})
+            pushers = list(self._pushers.values())
+        if inc is not None:
+            msg = {"type": "map_inc", "inc": inc, **extras}
+        else:
+            msg = {"type": "map_update", "payload": payload}
+        for p in pushers:
+            p.push(msg)
 
     def _h_get_inc(self, msg: Dict) -> Dict:
         with self._lock:
@@ -198,12 +339,27 @@ class Monitor:
             return got if got is not None else \
                 {"error": f"no epoch {epoch}"}
         with self._lock:
-            return json.loads(self._epochs[self.map.epoch])
+            if self._committed_epoch == 0:
+                return {"error": "no committed map yet"}
+            return json.loads(self._epochs[self._committed_epoch])
 
     def _h_subscribe(self, msg: Dict) -> Dict:
+        name, addr = msg["name"], tuple(msg["addr"])
         with self._lock:
-            self._subscribers[msg["name"]] = tuple(msg["addr"])
-            return json.loads(self._epochs[self.map.epoch])
+            old = self._subscribers.get(name)
+            self._subscribers[name] = addr
+            if old != addr:
+                stale = self._pushers.pop(name, None)
+                self._pushers[name] = _SubPusher(self.msgr, addr)
+            else:
+                stale = None
+            if self._committed_epoch == 0:
+                reply = {"error": "no committed map yet"}
+            else:
+                reply = json.loads(self._epochs[self._committed_epoch])
+        if stale is not None:
+            stale.stop()
+        return reply
 
     def _h_mark_down(self, msg: Dict) -> Dict:
         return {"epoch": self.mark_down(int(msg["osd"]))}
@@ -254,6 +410,8 @@ class Monitor:
         out_interval = self.ctx.conf["mon_osd_down_out_interval"]
         while self._running:
             time.sleep(interval / 2)
+            if self.quorum is not None and not self.quorum.is_leader():
+                continue  # failure detection is the leader's job
             now = time.monotonic()
             stale = []
             to_out = []
@@ -272,12 +430,56 @@ class Monitor:
                             self.map.osd_weight[osd] > 0:
                         to_out.append(osd)
                         del self._down_since[osd]
-            for osd in stale:
-                self.log.dout(1, f"osd.{osd} heartbeat stale")
-                self.mark_down(osd)
-            for osd in to_out:
-                self.log.dout(1, f"osd.{osd} auto-out")
-                with self._lock:
-                    self._auto_out[osd] = self.map.osd_weight[osd]
-                    self.map.osd_weight[osd] = 0
-                self._commit(f"osd.{osd} auto-out")
+            # a lost quorum mid-commit raises; the tick thread must
+            # survive it (the next leader retries the mark-down)
+            try:
+                for osd in stale:
+                    self.log.dout(1, f"osd.{osd} heartbeat stale")
+                    self.mark_down(osd)
+                for osd in to_out:
+                    self.log.dout(1, f"osd.{osd} auto-out")
+                    with self._lock:
+                        self._auto_out[osd] = self.map.osd_weight[osd]
+                        self.map.osd_weight[osd] = 0
+                    self._commit(f"osd.{osd} auto-out")
+            except RuntimeError as e:
+                self.log.derr(f"tick commit aborted: {e}")
+
+
+class _SubPusher:
+    """One subscriber's map-push lane: a bounded queue drained by its
+    own thread.  A peer that stops reading fills only its own queue
+    (oldest entries dropped — it will catch up via incrementals or a
+    full fetch) and can never stall the monitor's commit path."""
+
+    def __init__(self, msgr: Messenger, addr: Addr, depth: int = 64):
+        self.msgr = msgr
+        self.addr = tuple(addr)
+        self.q: "queue.Queue[Optional[Dict]]" = queue.Queue(depth)
+        self._th = threading.Thread(target=self._run, daemon=True,
+                                    name=f"mon-push:{addr[1]}")
+        self._th.start()
+
+    def push(self, msg: Dict) -> None:
+        while True:
+            try:
+                self.q.put_nowait(msg)
+                return
+            except queue.Full:
+                try:
+                    self.q.get_nowait()  # drop-oldest
+                except queue.Empty:
+                    pass
+
+    def _run(self) -> None:
+        while True:
+            msg = self.q.get()
+            if msg is None:
+                return
+            self.msgr.send(self.addr, msg)
+
+    def stop(self) -> None:
+        try:
+            self.q.put_nowait(None)
+        except queue.Full:
+            pass  # drain beats a leak; the daemon thread dies with us

@@ -45,7 +45,7 @@ class OSDService(MapFollower):
         self.ctx = ctx
         self.id = osd_id
         self.log = ctx.logger("osd")
-        self.mon_addr = tuple(mon_addr)
+        self._init_mons(mon_addr)  # one addr or the quorum list
         # data_dir = the OSD's persistent volume (superblock + data):
         # a restart remounts the checkpoint instead of backfilling
         # everything from peers (the reference's restart-replay flow)
@@ -114,13 +114,9 @@ class OSDService(MapFollower):
     def start(self) -> None:
         self.msgr.start()
         self._running = True
-        boot = self.msgr.call(self.mon_addr,
-                              {"type": "boot", "osd": self.id,
-                               "addr": list(self.addr)})
-        payload = self.msgr.call(self.mon_addr,
-                                 {"type": "subscribe",
-                                  "name": f"osd.{self.id}",
-                                  "addr": list(self.addr)})
+        boot = self.mon_call({"type": "boot", "osd": self.id,
+                              "addr": list(self.addr)}, tries=10)
+        payload = self.subscribe_all(f"osd.{self.id}")
         self._install_map(payload)
         self.log.dout(1, f"osd.{self.id} up (boot epoch "
                          f"{boot.get('epoch')})")
@@ -154,9 +150,8 @@ class OSDService(MapFollower):
             # the mon (the reference OSD's "map says I'm down" flow)
             self.log.dout(1, f"osd.{self.id} marked down in epoch "
                              f"{epoch}; re-booting to mon")
-            self.msgr.send(self.mon_addr,
-                           {"type": "boot", "osd": self.id,
-                            "addr": list(self.addr)})
+            self.mon_send({"type": "boot", "osd": self.id,
+                           "addr": list(self.addr)})
         self._recover_wake.set()
 
     def _h_map_update(self, msg: Dict) -> None:
@@ -268,8 +263,9 @@ class OSDService(MapFollower):
     def _beat_loop(self) -> None:
         interval = self.ctx.conf["osd_heartbeat_interval"]
         while self._running:
-            self.msgr.send(self.mon_addr,
-                           {"type": "heartbeat", "osd": self.id})
+            # mon_send reaches every quorum member: peons forward to
+            # the leader, so liveness survives any single monitor death
+            self.mon_send({"type": "heartbeat", "osd": self.id})
             time.sleep(interval)
 
     # -- recovery (mark-down -> remap -> recover) ----------------------

@@ -1,0 +1,386 @@
+"""Monitor quorum — rank election + replicated epoch log.
+
+The role of src/mon/ElectionLogic.cc + src/mon/Paxos.cc, bounded to the
+shape this framework needs: N monitors (typically 3) elect the
+lowest-ranked reachable monitor as leader, and every epoch commit is
+replicated to a majority before it becomes visible anywhere.
+
+Election (ElectionLogic.cc's lowest-rank-wins, epoch-numbered):
+- a candidate bumps the election epoch and proposes itself to every
+  peer; peers ack only proposers with a LOWER rank than their own, so
+  the lowest reachable rank collects a majority.  A monitor that sees a
+  proposal from a higher rank starts its own candidacy; rank-staggered
+  retry deadlines break ties.
+- the winner first SYNCS: collects last-committed versions (and any
+  accepted-but-uncommitted entry) from a majority, fetches whatever it
+  is missing, and re-proposes the highest uncommitted entry — the
+  Paxos collect/last phase (Paxos.cc:330-560) in single-decree form.
+  Majorities intersect, so any entry that ever reached a majority is
+  seen and preserved: epochs never fork.
+- leadership is kept alive with leases (Paxos.cc:1038 lease_*): the
+  leader broadcasts leases; a peon whose lease expires calls a new
+  election.
+
+Log replication (Paxos.cc begin/accept/commit, single-decree):
+- the leader sends ``mon_accept`` {epoch, version, entry} to peers; a
+  peer STAGES the entry (never applies it) and acks if the epoch is
+  current and the version is next-in-log.
+- on majority ack the leader applies locally and broadcasts
+  ``mon_commit``; peers then apply their staged entry.  A peer that
+  misses the commit catches up from the lease's last_committed via
+  ``mon_fetch``.
+- a leader that cannot reach a majority rolls its in-memory state back
+  to the last committed entry and abdicates — a partitioned minority
+  can commit nothing.
+
+The entry payload is the monitor's full epoch record (map json + inc +
+addr/profile extras), so a peon's store is always a prefix of the
+leader's and any monitor can serve reads and subscriptions.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+Addr = Tuple[str, int]
+
+PROBING = "probing"
+ELECTING = "electing"
+LEADER = "leader"
+PEON = "peon"
+
+
+class Quorum:
+    def __init__(self, mon, rank: int, addrs: List[Addr],
+                 lease: float = 1.0, election_timeout: float = 1.0,
+                 call_timeout: float = 1.5):
+        self.mon = mon
+        self.rank = rank
+        self.addrs = [tuple(a) for a in addrs]
+        self.n = len(addrs)
+        self.majority = self.n // 2 + 1
+        self.lease = lease
+        self.election_timeout = election_timeout
+        self.call_timeout = call_timeout
+
+        self.state = PROBING
+        self.election_epoch = 0
+        self.leader_rank: Optional[int] = None
+        self.lease_expiry = 0.0
+        self._next_election = 0.0
+        # accepted-but-uncommitted entry: {"v": int, "e": int,
+        # "entry": {...}} — never applied until mon_commit
+        self.uncommitted: Optional[Dict] = None
+        self._lock = threading.RLock()
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+        m = mon.msgr
+        m.register("mon_propose", self._h_propose)
+        m.register("mon_victory", self._h_victory)
+        m.register("mon_lease", self._h_lease)
+        m.register("mon_collect", self._h_collect)
+        m.register("mon_fetch", self._h_fetch)
+        m.register("mon_accept", self._h_accept)
+        m.register("mon_commit", self._h_commit)
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(target=self._tick_loop,
+                                        daemon=True,
+                                        name=f"mon{self.rank}-quorum")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    # -- state queries ---------------------------------------------------
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.state == LEADER
+
+    def leader_addr(self) -> Optional[Addr]:
+        with self._lock:
+            if self.leader_rank is None:
+                return None
+            return self.addrs[self.leader_rank]
+
+    def _others(self):
+        return [(r, a) for r, a in enumerate(self.addrs)
+                if r != self.rank]
+
+    # -- the ticker -------------------------------------------------------
+    def _tick_loop(self) -> None:
+        # rank-staggered first election so rank 0 usually wins round 1
+        time.sleep(0.02 * self.rank)
+        while self._running:
+            try:
+                self._tick()
+            except Exception as e:  # a tick must never kill the thread
+                self.mon.log.derr(f"quorum tick: {e!r}")
+            time.sleep(self.lease / 3)
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            state = self.state
+            lease_out = now > self.lease_expiry
+            due = now >= self._next_election
+        if state == LEADER:
+            self._send_leases()
+        elif state == PEON and lease_out:
+            self.mon.log.dout(1, f"mon.{self.rank}: lease expired, "
+                                 f"calling election")
+            self._start_election()
+        elif state in (PROBING, ELECTING) and due:
+            self._start_election()
+
+    # -- election ---------------------------------------------------------
+    def _start_election(self) -> None:
+        with self._lock:
+            self.election_epoch += 1
+            e = self.election_epoch
+            self.state = ELECTING
+            self.leader_rank = None
+            # stagger retries by rank so the lowest reachable rank
+            # converges first instead of livelocking
+            self._next_election = time.monotonic() + \
+                self.election_timeout * (1 + 0.5 * self.rank
+                                         + 0.2 * random.random())
+        acks = 1
+        infos = [{"rank": self.rank,
+                  "last_committed": self.mon.last_committed()}]
+        for r, addr in self._others():
+            try:
+                rep = self.mon.msgr.call(
+                    addr, {"type": "mon_propose", "e": e,
+                           "rank": self.rank},
+                    timeout=self.call_timeout)
+            except (OSError, TimeoutError):
+                continue
+            if rep.get("ack"):
+                acks += 1
+                infos.append({"rank": r,
+                              "last_committed":
+                                  rep.get("last_committed", 0)})
+        with self._lock:
+            if self.election_epoch != e or self.state != ELECTING:
+                return  # a newer round superseded this one
+            if acks < self.majority:
+                return  # retry at the staggered deadline
+        self._win(e, infos)
+
+    def _h_propose(self, msg: Dict) -> Dict:
+        e, r = int(msg["e"]), int(msg["rank"])
+        with self._lock:
+            if e < self.election_epoch:
+                return {"ack": False, "epoch": self.election_epoch}
+            if e > self.election_epoch:
+                self.election_epoch = e
+                # a new round invalidates current leadership
+                if self.state in (LEADER, PEON):
+                    self.state = ELECTING
+                    self.leader_rank = None
+            ack = r < self.rank
+            if not ack:
+                # I outrank the proposer and I'm alive: stand myself
+                self._next_election = time.monotonic()
+            return {"ack": ack, "epoch": self.election_epoch,
+                    "last_committed": self.mon.last_committed()}
+
+    def _win(self, e: int, infos: List[Dict]) -> None:
+        """Sync to the newest majority state, then declare victory.
+
+        ``infos`` (rank, last_committed) comes from the majority of
+        propose acks, so the newest committed version is known even if
+        every explicit collect call below fails; the collect round
+        additionally gathers staged-but-uncommitted entries."""
+        uncommitted = []
+        with self._lock:
+            if self.uncommitted is not None:
+                uncommitted.append(self.uncommitted)
+        best_lc = self.mon.last_committed()
+        best_peer = None
+        for row in infos:
+            if row["rank"] != self.rank and \
+                    int(row["last_committed"]) > best_lc:
+                best_lc = int(row["last_committed"])
+                best_peer = self.addrs[row["rank"]]
+        for r, addr in self._others():
+            try:
+                rep = self.mon.msgr.call(addr,
+                                         {"type": "mon_collect", "e": e},
+                                         timeout=self.call_timeout)
+            except (OSError, TimeoutError):
+                continue
+            lc = int(rep.get("last_committed", 0))
+            if lc > best_lc:
+                best_lc, best_peer = lc, addr
+            if rep.get("uncommitted"):
+                uncommitted.append(rep["uncommitted"])
+        if best_peer is not None:
+            self._fetch_from(best_peer, best_lc)
+
+        with self._lock:
+            if self.election_epoch != e:
+                return
+            self.state = LEADER
+            self.leader_rank = self.rank
+            self.lease_expiry = time.monotonic() + self.lease * 3
+        for r, addr in self._others():
+            try:
+                self.mon.msgr.call(addr,
+                                   {"type": "mon_victory", "e": e,
+                                    "leader": self.rank},
+                                   timeout=self.call_timeout)
+            except (OSError, TimeoutError):
+                pass
+        self.mon.log.dout(1, f"mon.{self.rank}: leader at election "
+                             f"epoch {e}, last_committed {best_lc}")
+        self.mon.on_leader(
+            self._pick_uncommitted(uncommitted, best_lc))
+
+    def _pick_uncommitted(self, entries: List[Dict],
+                          lc: int) -> Optional[Dict]:
+        """The next-in-log staged entry with the highest election
+        epoch, if any (Paxos: re-propose the highest accepted value)."""
+        best = None
+        for u in entries:
+            if int(u["v"]) != lc + 1:
+                continue
+            if best is None or int(u["e"]) > int(best["e"]):
+                best = u
+        return best
+
+    def _fetch_from(self, addr: Addr, to_v: int) -> None:
+        """Pull committed entries (last_committed, to_v] and apply."""
+        frm = self.mon.last_committed()
+        try:
+            rep = self.mon.msgr.call(
+                addr, {"type": "mon_fetch", "from_v": frm,
+                       "to_v": to_v},
+                timeout=self.call_timeout * 2)
+        except (OSError, TimeoutError):
+            return
+        for row in rep.get("entries", []):
+            if int(row["v"]) == self.mon.last_committed() + 1:
+                self.mon.apply_committed(int(row["v"]), row["entry"])
+
+    def _h_victory(self, msg: Dict) -> Dict:
+        e, leader = int(msg["e"]), int(msg["leader"])
+        with self._lock:
+            if e < self.election_epoch:
+                return {"ok": False, "epoch": self.election_epoch}
+            self.election_epoch = e
+            self.state = PEON if leader != self.rank else LEADER
+            self.leader_rank = leader
+            self.lease_expiry = time.monotonic() + self.lease * 3
+        return {"ok": True,
+                "last_committed": self.mon.last_committed()}
+
+    # -- leases -----------------------------------------------------------
+    def _send_leases(self) -> None:
+        with self._lock:
+            e = self.election_epoch
+            if self.state != LEADER:
+                return
+            # the leader's own lease: refreshed by virtue of being able
+            # to tick (its authority is checked at every commit anyway)
+            self.lease_expiry = time.monotonic() + self.lease * 3
+        msg = {"type": "mon_lease", "e": e, "leader": self.rank,
+               "last_committed": self.mon.last_committed()}
+        for r, addr in self._others():
+            self.mon.msgr.send(addr, msg)
+
+    def _h_lease(self, msg: Dict) -> None:
+        e, leader = int(msg["e"]), int(msg["leader"])
+        with self._lock:
+            if e < self.election_epoch:
+                return None
+            if e > self.election_epoch or self.leader_rank != leader:
+                self.election_epoch = e
+                self.leader_rank = leader
+                self.state = PEON if leader != self.rank else LEADER
+            self.lease_expiry = time.monotonic() + self.lease * 3
+            leader_addr = self.addrs[leader]
+        # catch up on committed entries we missed (dropped mon_commit)
+        lc = int(msg.get("last_committed", 0))
+        if lc > self.mon.last_committed():
+            self._fetch_from(leader_addr, lc)
+        return None
+
+    # -- replication ------------------------------------------------------
+    def replicate(self, v: int, entry: Dict) -> bool:
+        """Leader path: stage on a majority, then commit everywhere.
+        Returns False (caller rolls back + abdicates) on lost quorum."""
+        with self._lock:
+            if self.state != LEADER:
+                return False
+            e = self.election_epoch
+        acks = 1
+        for r, addr in self._others():
+            try:
+                rep = self.mon.msgr.call(
+                    addr, {"type": "mon_accept", "e": e, "v": v,
+                           "entry": entry},
+                    timeout=self.call_timeout)
+            except (OSError, TimeoutError):
+                continue
+            if rep.get("ack"):
+                acks += 1
+        if acks < self.majority:
+            return False
+        with self._lock:
+            if self.state != LEADER or self.election_epoch != e:
+                return False
+        for r, addr in self._others():
+            self.mon.msgr.send(addr, {"type": "mon_commit", "e": e,
+                                      "v": v})
+        return True
+
+    def _h_accept(self, msg: Dict) -> Dict:
+        e, v = int(msg["e"]), int(msg["v"])
+        with self._lock:
+            if e < self.election_epoch or self.state == LEADER:
+                return {"ack": False, "epoch": self.election_epoch}
+            if v != self.mon.last_committed() + 1:
+                return {"ack": False,
+                        "last_committed": self.mon.last_committed()}
+            self.uncommitted = {"v": v, "e": e, "entry": msg["entry"]}
+            return {"ack": True}
+
+    def _h_commit(self, msg: Dict) -> None:
+        v = int(msg["v"])
+        with self._lock:
+            u = self.uncommitted
+            if u is None or int(u["v"]) != v:
+                return None
+            self.uncommitted = None
+            entry = u["entry"]
+        if v == self.mon.last_committed() + 1:
+            self.mon.apply_committed(v, entry)
+        return None
+
+    def _h_collect(self, msg: Dict) -> Dict:
+        with self._lock:
+            u = self.uncommitted
+        return {"last_committed": self.mon.last_committed(),
+                "uncommitted": u}
+
+    def _h_fetch(self, msg: Dict) -> Dict:
+        frm, to = int(msg["from_v"]), int(msg["to_v"])
+        return {"entries": self.mon.committed_entries(frm, to)}
+
+    def abdicate(self) -> None:
+        """Step down after a failed replication (lost majority)."""
+        with self._lock:
+            if self.state == LEADER:
+                self.state = ELECTING
+                self.leader_rank = None
+                self._next_election = time.monotonic()
