@@ -302,6 +302,23 @@ def worker_ec_cpu():
     _stage_ec("cpu")
 
 
+def worker_cluster():
+    """End-to-end MiniCluster throughput (the rados-bench analogue,
+    src/common/obj_bencher.cc role): write + seq-read IOPS/latency."""
+    from ceph_tpu.tools.rados_bench import bench_minicluster
+
+    out = bench_minicluster(op="seq", seconds=2.0, concurrent=8,
+                            object_size=1 << 16, n_osds=4)
+    _emit(stage="cluster",
+          write_iops=out["write"].get("iops"),
+          write_mbps=out["write"].get("mb_per_sec"),
+          write_p99_ms=out["write"].get("lat_p99_ms"),
+          seq_iops=out.get("seq", {}).get("iops"),
+          seq_mbps=out.get("seq", {}).get("mb_per_sec"),
+          seq_p99_ms=out.get("seq", {}).get("lat_p99_ms"),
+          n_osds=out.get("n_osds"))
+
+
 # ---------------------------------------------------------------------------
 # parent side (orchestration; no jax import)
 # ---------------------------------------------------------------------------
@@ -500,6 +517,16 @@ def main():
     if acc is not None:
         acc.kill("bench done")
 
+    # cluster throughput phase (secondary; rados-bench analogue)
+    clw = Stream(_spawn("cluster", "cpu"), "cluster/cpu")
+    cl_res = clw.wait(lambda r: r.get("stage") == "cluster", 90)
+    clw.kill("done")
+    if cl_res is not None:
+        print(f"# cluster 4-osd: write {cl_res['write_iops']} IOPS "
+              f"({cl_res['write_mbps']} MB/s, p99 "
+              f"{cl_res['write_p99_ms']} ms); seq {cl_res['seq_iops']}"
+              f" IOPS ({cl_res['seq_mbps']} MB/s)", file=sys.stderr)
+
 
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
@@ -508,6 +535,7 @@ if __name__ == "__main__":
         apply_platform_env()
         {"staged": worker_staged,
          "crush_cpu": worker_crush_cpu,
-         "ec_cpu": worker_ec_cpu}[sys.argv[2]]()
+         "ec_cpu": worker_ec_cpu,
+         "cluster": worker_cluster}[sys.argv[2]]()
     else:
         main()

@@ -124,3 +124,93 @@ def default_osd_queue() -> MClockQueue:
         RECOVERY: ClientInfo(reservation=20.0, weight=0.5, limit=100.0),
         SCRUB: ClientInfo(reservation=0.0, weight=0.2, limit=50.0),
     })
+
+
+class OpScheduler:
+    """Threaded front for MClockQueue — the OpScheduler/shard-worker
+    seam (src/osd/scheduler/OpScheduler.h + OSD::ShardedOpWQ role):
+    handler threads submit (class, thunk) and block for the result;
+    a small worker pool serves strictly in dmClock tag order, so QoS
+    between client/recovery/scrub ops is enforced at the store door."""
+
+    def __init__(self, queue: Optional[MClockQueue] = None,
+                 n_workers: int = 2):
+        import threading
+
+        # NOT `queue or ...`: an empty MClockQueue is len()==0 falsy
+        self.q = queue if queue is not None else default_osd_queue()
+        self._cv = threading.Condition()
+        self._running = True
+        self.served: Dict[str, int] = collections.defaultdict(int)
+        self._workers = [
+            threading.Thread(target=self._work, daemon=True,
+                             name=f"mclock-w{i}")
+            for i in range(n_workers)]
+        for w in self._workers:
+            w.start()
+
+    def submit(self, cls: str, fn):
+        """Run ``fn`` under class ``cls``; blocks until served."""
+        import threading
+        import time as _time
+
+        done = threading.Event()
+        box: list = [None, None]  # result, exception
+
+        def job():
+            try:
+                box[0] = fn()
+            except BaseException as e:  # propagated to the submitter
+                box[1] = e
+            finally:
+                done.set()
+
+        with self._cv:
+            if not self._running:
+                raise RuntimeError("op scheduler shut down")
+            self.q.enqueue(cls, job, _time.monotonic())
+            self._cv.notify()
+        done.wait()
+        if box[1] is not None:
+            raise box[1]
+        return box[0]
+
+    def _work(self) -> None:
+        import time as _time
+
+        while True:
+            with self._cv:
+                while self._running:
+                    got = self.q.dequeue(_time.monotonic())
+                    if got is not None:
+                        break
+                    nxt = self.q.next_ready_at()
+                    delay = max(0.001, min(
+                        0.2, nxt - _time.monotonic())) \
+                        if nxt != math.inf else 0.2
+                    self._cv.wait(timeout=delay)
+                if not self._running:
+                    return
+                cls, job = got
+                self.served[cls] += 1
+            job()
+
+    def depths(self) -> Dict[str, int]:
+        with self._cv:
+            return {c: len(q) for c, q in self.q._queues.items() if q}
+
+    def shutdown(self) -> None:
+        """Stop workers, then drain every queued job inline — a job
+        abandoned un-run would leave its submitter blocked in
+        done.wait() forever."""
+        with self._cv:
+            self._running = False
+            self._cv.notify_all()
+            leftovers = []
+            while True:
+                got = self.q.dequeue(math.inf)
+                if got is None:
+                    break
+                leftovers.append(got[1])
+        for job in leftovers:
+            job()

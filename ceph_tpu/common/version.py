@@ -1,0 +1,21 @@
+"""Object versions — the eversion_t (epoch, version) role.
+
+One definition shared by writers (client), storers (osd_service), and
+peering: zero-padded decimal fields so STRING comparison is version
+comparison.  Any change here must change every comparer at once —
+that's why there is exactly one copy.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def make_version(epoch: int) -> str:
+    """Totally-ordered object version: map epoch + wall timestamp.
+    All shards of one logical write share one version, so replicas
+    agree on recency at peering time."""
+    return f"{epoch:012d}.{time.time_ns():020d}"
+
+
+NULL_VERSION = "0" * 12 + "." + "0" * 20

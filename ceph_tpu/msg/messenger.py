@@ -1,16 +1,40 @@
-"""Messenger — threaded TCP transport with typed JSON dispatch.
+"""Messenger — threaded TCP transport with typed JSON dispatch and
+session-layer reliability.
 
 The Messenger/Dispatcher seam (src/msg/Messenger.h, Dispatcher.h,
-AsyncMessenger.cc) for the host control plane.  Framing: 4-byte
-big-endian length + JSON body (binary payloads travel hex-encoded —
-control-plane sizes, not data-plane).  Each messenger owns an accept
-thread and per-connection reader threads; ``send`` opens (and caches)
-client connections and is fire-and-forget; ``call`` is send + wait for
-a reply correlated by ``tid`` (the MOSDOp/reply pattern).
+AsyncMessenger.cc) plus the ProtocolV2 session layer
+(src/msg/async/ProtocolV2.cc): framing is 4-byte big-endian length +
+JSON body; on top of it, LOSSLESS peers (daemon↔daemon — the
+reference's CEPH_MSGR_POLICY_LOSSLESS) get sequence-numbered frames
+with ack/replay semantics:
+
+- every sequenced frame carries (_sess, _s); the receiver keeps
+  in_seq per (peer, session) and a bounded reply cache, so a frame
+  that arrives twice (retransmission after a dropped connection) is
+  deduplicated and its original reply is resent — exactly-once
+  handler execution per session, the reconnect/replay contract of
+  ProtocolV2.cc (out_seq/in_seq + requeue_sent).
+- the sender buffers unacked frames; a reconnect handshake
+  (``__hello__``) learns the peer's in_seq and retransmits only the
+  tail; explicit ``__ack__`` frames trim the buffer in steady state.
+  A reader-thread death with unacked frames triggers a background
+  resync so a dropped TCP connection mid-op-stream heals without
+  waiting for the next application send.
+- the HMAC (msg/auth.py) signs the body INCLUDING (_sess, _s), so a
+  captured frame replayed verbatim is rejected by the in_seq check —
+  the cephx nonce-binding role.
+- LOSSY peers (clients) keep the old fire-and-forget behavior
+  (CEPH_MSGR_POLICY_LOSSY: the application's map-retry loop owns
+  recovery), but every receiver still deduplicates sequenced traffic.
+
+Per-type byte throttles (``throttles={type: Throttle}``) bound memory
+taken by in-flight messages of a type before dispatch — the
+osd_client_message_size_cap role (ceph_osd.cc:582-588).
 """
 
 from __future__ import annotations
 
+import collections
 import json
 import socket
 import struct
@@ -28,6 +52,9 @@ Handler = Callable[[Dict], Optional[Dict]]
 _send_locks: Dict[int, threading.Lock] = {}
 _send_locks_guard = threading.Lock()
 
+_UNACKED_CAP = 512      # frames buffered per lossless peer session
+_REPLY_CACHE_CAP = 128  # replies cached per remote session
+
 
 def _send_frame(sock: socket.socket, msg: Dict) -> None:
     body = json.dumps(msg).encode()
@@ -37,7 +64,7 @@ def _send_frame(sock: socket.socket, msg: Dict) -> None:
         sock.sendall(struct.pack(">I", len(body)) + body)
 
 
-def _recv_frame(sock: socket.socket) -> Optional[Dict]:
+def _recv_frame(sock: socket.socket):
     header = b""
     while len(header) < 4:
         got = sock.recv(4 - len(header))
@@ -51,14 +78,78 @@ def _recv_frame(sock: socket.socket) -> Optional[Dict]:
         if not got:
             return None
         body += got
-    return json.loads(body.decode())
+    return json.loads(body.decode()), length
+
+
+class _OutSession:
+    """Sender-side lossless state for one peer address."""
+
+    def __init__(self):
+        self.lock = threading.RLock()  # serializes seq assignment,
+        # handshake, and transmission → frames hit the wire in order
+        # buf_lock guards ONLY the unacked buffer: acks arrive on
+        # reader threads and must trim without waiting on a handshake
+        # in progress (which itself waits on that reader — deadlock)
+        self.buf_lock = threading.Lock()
+        self.out_seq = 0
+        self.unacked: "collections.OrderedDict[int, Dict]" = \
+            collections.OrderedDict()
+        self.synced = False  # handshake done on the current conn
+
+    def trim(self, upto: int) -> None:
+        """Transport-level ack: drops fire-and-forget frames only.  A
+        frame still waiting for its REPLY stays buffered even though
+        the peer received it — the reply may have died with the old
+        connection, and only the retransmission (deduped server-side,
+        cached reply resent) can recover it.  call() completes those
+        via complete()."""
+        with self.buf_lock:
+            for s in list(self.unacked):
+                if s > upto:
+                    break
+                frame, needs_reply = self.unacked[s]
+                if not needs_reply:
+                    del self.unacked[s]
+
+    def complete(self, seq: int) -> None:
+        with self.buf_lock:
+            self.unacked.pop(seq, None)
+
+    def buffer(self, seq: int, frame: Dict,
+               needs_reply: bool) -> None:
+        with self.buf_lock:
+            self.unacked[seq] = (frame, needs_reply)
+            while len(self.unacked) > _UNACKED_CAP:
+                self.unacked.popitem(last=False)  # degrade to lossy
+
+    def pending(self):
+        with self.buf_lock:
+            return [f for f, _nr in self.unacked.values()]
+
+
+class _InSession:
+    """Receiver-side dedup state for one remote (name, session)."""
+
+    def __init__(self):
+        self.in_seq = 0
+        self.replies: "collections.OrderedDict[int, Dict]" = \
+            collections.OrderedDict()
+
+    def cache_reply(self, seq: int, frame: Dict) -> None:
+        self.replies[seq] = frame
+        while len(self.replies) > _REPLY_CACHE_CAP:
+            self.replies.popitem(last=False)
 
 
 class Messenger:
     def __init__(self, name: str, host: str = "127.0.0.1",
-                 port: int = 0, keyring=None):
+                 port: int = 0, keyring=None, lossless: bool = False,
+                 throttles: Optional[Dict[str, object]] = None):
         self.name = name
         self.keyring = keyring  # cephx-style frame auth when set
+        self.lossless = lossless
+        self.session_id = uuid.uuid4().hex[:16]
+        self.throttles = throttles or {}
         self._handlers: Dict[str, Handler] = {}
         self._listener = socket.socket(socket.AF_INET,
                                        socket.SOCK_STREAM)
@@ -72,6 +163,9 @@ class Messenger:
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: Dict[Addr, socket.socket] = {}
         self._conn_lock = threading.Lock()
+        self._out: Dict[Addr, _OutSession] = {}
+        self._in: Dict[Tuple[str, str], _InSession] = {}
+        self._in_lock = threading.Lock()
         self._pending: Dict[str, Dict] = {}
         self._waiting: set = set()  # tids with a live waiter
         self._pending_cv = threading.Condition()
@@ -96,21 +190,51 @@ class Messenger:
                 continue
             except OSError:
                 break
-            threading.Thread(target=self._reader, args=(conn,),
+            threading.Thread(target=self._reader, args=(conn, None),
                              daemon=True).start()
 
-    def _reader(self, conn: socket.socket) -> None:
+    def _reader(self, conn: socket.socket, addr: Optional[Addr]) -> None:
+        """``addr`` set = a client-initiated connection we own; its
+        death with unacked frames triggers a background resync."""
         with conn:
             while self._running:
                 try:
-                    msg = _recv_frame(conn)
+                    got = _recv_frame(conn)
                 except (OSError, ValueError):
                     break  # closed or corrupt frame: drop the session
-                if msg is None:
+                if got is None:
                     break
-                self._dispatch(conn, msg)
+                msg, nbytes = got
+                self._dispatch(conn, msg, nbytes)
         with _send_locks_guard:
             _send_locks.pop(id(conn), None)
+        if addr is not None:
+            self._on_conn_death(addr, conn)
+
+    def _on_conn_death(self, addr: Addr, conn) -> None:
+        with self._conn_lock:
+            if self._conns.get(addr) is conn:
+                self._conns.pop(addr, None)
+        sess = self._out.get(addr)
+        if sess is not None:
+            with sess.lock:
+                sess.synced = False
+                dirty = bool(sess.unacked)
+            if dirty and self._running:
+                threading.Thread(target=self._resync, args=(addr,),
+                                 daemon=True).start()
+
+    def _resync(self, addr: Addr) -> None:
+        """Reconnect + replay after a dropped lossless connection."""
+        for attempt in range(5):
+            if not self._running:
+                return
+            try:
+                with self._out[addr].lock:
+                    self._ensure_synced(addr)
+                return
+            except (OSError, TimeoutError):
+                time.sleep(0.1 * (attempt + 1))
 
     def _sign(self, msg: Dict) -> Dict:
         if self.keyring is not None:
@@ -118,7 +242,8 @@ class Messenger:
             msg["mac"] = self.keyring.sign(msg)
         return msg
 
-    def _dispatch(self, conn: socket.socket, msg: Dict) -> None:
+    def _dispatch(self, conn: socket.socket, msg: Dict,
+                  nbytes: int) -> None:
         if self.keyring is not None and not self.keyring.verify(msg):
             return  # unauthenticated frame: drop silently (cephx deny)
         type_ = msg.get("type", "")
@@ -128,19 +253,96 @@ class Messenger:
                     self._pending[msg["tid"]] = msg.get("payload", {})
                     self._pending_cv.notify_all()
             return
-        handler = self._handlers.get(type_)
-        if handler is None:
-            reply = {"error": f"no handler for {type_!r}"}
-        else:
+        if type_ == "__ack__":
+            sess = self._out.get(tuple(msg["addr"]))
+            if sess is not None and msg.get("sess") == self.session_id:
+                sess.trim(int(msg["in_seq"]))  # buf_lock only: an ack
+                # must never wait behind a handshake on this session
+            return
+        if type_ == "__hello__":
+            key = (msg.get("frm", ""), msg.get("sess", ""))
+            with self._in_lock:
+                ins = self._in.setdefault(key, _InSession())
+            self._reply(conn, msg,
+                        {"in_seq": ins.in_seq, "ok": True})
+            return
+
+        seq = msg.get("_s")
+        ins = None
+        if seq is not None:
+            key = (msg.get("frm", ""), msg.get("_sess", ""))
+            with self._in_lock:
+                ins = self._in.setdefault(key, _InSession())
+                dup = seq <= ins.in_seq
+                if not dup:
+                    ins.in_seq = seq
+            if dup:
+                # duplicate (retransmission or replayed capture):
+                # never re-execute; resend the original reply.  If the
+                # original is still being handled on another thread,
+                # wait briefly for its reply to land in the cache.
+                if msg.get("tid") is not None:
+                    deadline = time.monotonic() + 2.0
+                    while time.monotonic() < deadline:
+                        with self._in_lock:
+                            cached = ins.replies.get(seq)
+                        if cached is not None:
+                            try:
+                                _send_frame(conn, cached)
+                            except OSError:
+                                pass
+                            return
+                        time.sleep(0.02)
+                return
+
+        throttle = self.throttles.get(type_)
+        if throttle is not None:
+            if nbytes > throttle.max:
+                # an unsatisfiable get() would wedge this reader thread
+                # forever; oversized messages are a protocol error
+                self._reply(conn, msg, {"error": "message too large"})
+                return
+            throttle.get(nbytes)
+        try:
+            handler = self._handlers.get(type_)
+            if handler is None:
+                reply = {"error": f"no handler for {type_!r}"}
+            else:
+                try:
+                    reply = handler(msg)
+                except Exception as e:
+                    reply = {"error": str(e)}
+        finally:
+            if throttle is not None:
+                throttle.put(nbytes)
+
+        frame = None
+        if msg.get("tid") is not None:
+            frame = self._sign({"type": "__reply__",
+                                "tid": msg["tid"],
+                                "payload": reply})
             try:
-                reply = handler(msg)
-            except Exception as e:
-                reply = {"error": str(e)}
+                _send_frame(conn, frame)
+            except OSError:
+                pass
+        if ins is not None:
+            if frame is not None:
+                with self._in_lock:
+                    ins.cache_reply(seq, frame)
+            # ack so the sender can trim its unacked buffer
+            try:
+                _send_frame(conn, self._sign(
+                    {"type": "__ack__", "sess": msg.get("_sess"),
+                     "in_seq": seq, "addr": list(self.addr)}))
+            except OSError:
+                pass
+
+    def _reply(self, conn, msg: Dict, payload: Dict) -> None:
         if msg.get("tid") is not None:
             try:
                 _send_frame(conn, self._sign(
                     {"type": "__reply__", "tid": msg["tid"],
-                     "payload": reply}))
+                     "payload": payload}))
             except OSError:
                 pass
 
@@ -153,7 +355,7 @@ class Messenger:
                 return sock
             sock = socket.create_connection(addr, timeout=5)
             self._conns[addr] = sock
-            threading.Thread(target=self._reader, args=(sock,),
+            threading.Thread(target=self._reader, args=(sock, addr),
                              daemon=True).start()
             return sock
 
@@ -166,9 +368,89 @@ class Messenger:
             except OSError:
                 pass
 
+    def _session(self, addr: Addr) -> _OutSession:
+        addr = tuple(addr)
+        sess = self._out.get(addr)
+        if sess is None:
+            sess = self._out.setdefault(addr, _OutSession())
+        return sess
+
+    def _raw_call(self, addr: Addr, msg: Dict,
+                  timeout: float = 5.0) -> Dict:
+        """tid-correlated exchange below the session layer (the
+        handshake itself must not be sequenced)."""
+        tid = uuid.uuid4().hex
+        msg = self._sign(dict(msg, tid=tid, frm=self.name))
+        deadline = time.monotonic() + timeout
+        with self._pending_cv:
+            self._waiting.add(tid)
+        try:
+            _send_frame(self._connect(addr), msg)
+            with self._pending_cv:
+                while tid not in self._pending:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._pending_cv.wait(
+                            timeout=min(0.5, remaining)):
+                        if time.monotonic() >= deadline:
+                            raise TimeoutError(
+                                f"{self.name}: no hello reply from "
+                                f"{addr}")
+                return self._pending.pop(tid)
+        finally:
+            with self._pending_cv:
+                self._waiting.discard(tid)
+                self._pending.pop(tid, None)
+
+    def _ensure_synced(self, addr: Addr) -> None:
+        """Under the session lock: connect, handshake, replay the
+        unacked tail past the peer's in_seq (ProtocolV2 reconnect).
+        Replays every buffered frame, so callers must NOT also send
+        frames buffered before this ran."""
+        sess = self._session(addr)
+        sock = self._connect(addr)
+        if sess.synced:
+            return
+        rep = self._raw_call(addr, {"type": "__hello__",
+                                    "sess": self.session_id},
+                             timeout=5.0)
+        peer_in = int(rep.get("in_seq", 0))
+        sess.trim(peer_in)
+        for frame in sess.pending():
+            _send_frame(sock, frame)
+        sess.synced = True
+
+    def _send_sequenced(self, addr: Addr, msg: Dict) -> int:
+        """Returns the assigned seq (call() completes it on reply)."""
+        sess = self._session(addr)
+        with sess.lock:
+            sess.out_seq += 1
+            seq = sess.out_seq
+            frame = self._sign(dict(msg, _s=seq,
+                                    _sess=self.session_id,
+                                    frm=self.name))
+            sess.buffer(seq, frame, msg.get("tid") is not None)
+            try:
+                if sess.synced:
+                    _send_frame(self._connect(addr), frame)
+                else:
+                    self._ensure_synced(addr)  # replays incl. frame
+            except (OSError, TimeoutError):
+                # one immediate retry on a fresh connection; further
+                # healing happens in the background resync
+                self._drop(addr)
+                sess.synced = False
+                self._ensure_synced(addr)
+            return seq
+
     def send(self, addr: Addr, msg: Dict) -> None:
-        """Fire-and-forget; one silent reconnect attempt (lossy
-        policy)."""
+        """Fire-and-forget.  Lossless: sequenced + replayed across
+        reconnects.  Lossy: one silent reconnect attempt."""
+        if self.lossless:
+            try:
+                self._send_sequenced(addr, msg)
+            except (OSError, TimeoutError):
+                pass  # unacked buffer + resync own the retry
+            return
         msg = self._sign(msg)
         for _ in range(2):
             try:
@@ -179,23 +461,27 @@ class Messenger:
 
     def call(self, addr: Addr, msg: Dict,
              timeout: float = 10.0) -> Dict:
-        """Request/response correlated by tid.  A timeout does NOT
-        close the (shared) connection — other in-flight calls on the
-        same peer keep their replies; a genuinely dead socket raises
-        OSError on the next send and is reconnected there."""
+        """Request/response correlated by tid.  On a lossless
+        messenger the request is sequenced: if the connection drops
+        after the peer processed it, the retransmission is deduped and
+        the cached reply resent — exactly-once execution."""
         tid = uuid.uuid4().hex
-        msg = self._sign(dict(msg, tid=tid, frm=self.name))
         deadline = time.monotonic() + timeout
+        seq = None
         with self._pending_cv:
             self._waiting.add(tid)
         try:
-            try:
-                _send_frame(self._connect(addr), msg)
-            except OSError:
-                # stale cached connection (peer restarted): one fresh
-                # reconnect before giving up
-                self._drop(addr)
-                _send_frame(self._connect(addr), msg)
+            if self.lossless:
+                seq = self._send_sequenced(addr, dict(msg, tid=tid))
+            else:
+                smsg = self._sign(dict(msg, tid=tid, frm=self.name))
+                try:
+                    _send_frame(self._connect(addr), smsg)
+                except OSError:
+                    # stale cached connection (peer restarted): one
+                    # fresh reconnect before giving up
+                    self._drop(addr)
+                    _send_frame(self._connect(addr), smsg)
             with self._pending_cv:
                 while tid not in self._pending:
                     remaining = deadline - time.monotonic()
@@ -210,6 +496,10 @@ class Messenger:
             self._drop(addr)
             raise
         finally:
+            if seq is not None:
+                # replied, timed out, or failed: either way this call
+                # is over — stop replaying its request
+                self._session(addr).complete(seq)
             with self._pending_cv:
                 self._waiting.discard(tid)
                 self._pending.pop(tid, None)

@@ -81,14 +81,23 @@ class Client(MapFollower):
         return code
 
     def _up(self, pool_id: int, oid: str):
+        """Route to the ACTING set (pg_temp overlay included): during
+        backfill the acting members hold the data and take the IO —
+        the serving-continuity contract of peering (OSDMap.cc:2590)."""
         pool = self.map.pools[pool_id]
         ps = object_to_ps(oid) % pool.pg_num
-        up, _p, _a, _ap = self.map.pg_to_up_acting_osds(pool_id, ps)
-        return pool, ps, up
+        up, _p, acting, _ap = self.map.pg_to_up_acting_osds(pool_id,
+                                                           ps)
+        return pool, ps, (acting if acting else up)
 
     # -- data path -------------------------------------------------------
     def put(self, pool_id: int, oid: str, data: bytes,
             retries: int = 3) -> None:
+        from ..common.version import make_version
+
+        # one version for every shard of this logical write: replicas
+        # agree on recency at peering time (the eversion_t role)
+        v = make_version(self.epoch)
         for attempt in range(retries):
             pool, ps, up = self._up(pool_id, oid)
             code = self._code_for(pool)
@@ -96,7 +105,7 @@ class Client(MapFollower):
                 if code is None:
                     for pos, osd in enumerate(up):
                         self._write_shard(pool_id, ps, oid, osd, 0,
-                                          data, len(data))
+                                          data, len(data), v)
                 else:
                     n = code.get_chunk_count()
                     chunks = code.encode(range(n), data)
@@ -112,7 +121,7 @@ class Client(MapFollower):
                             pool_id, ps, oid, up[pos], pos,
                             np.asarray(chunks[pos],
                                        np.uint8).tobytes(),
-                            len(data))
+                            len(data), v)
                 return
             except (TimeoutError, OSError, KeyError):
                 if attempt + 1 == retries:
@@ -121,11 +130,12 @@ class Client(MapFollower):
                 self.refresh_map()
 
     def _write_shard(self, pool_id, ps, oid, osd, shard, data,
-                     size) -> None:
+                     size, v=None) -> None:
         got = self.msgr.call(self.osd_addrs[osd],
                              {"type": "shard_write", "pool": pool_id,
                               "ps": ps, "oid": oid, "shard": shard,
-                              "data": data.hex(), "size": size},
+                              "data": data.hex(), "size": size,
+                              "v": v},
                              timeout=10)
         if not got.get("ok"):
             raise OSError(f"shard_write to osd.{osd}: {got}")
@@ -160,9 +170,15 @@ class Client(MapFollower):
             self.refresh_map()
 
     def _read_replicated(self, pool_id, ps, oid, up) -> bytes:
+        """Version-aware: while divergent histories are still
+        reconciling, replicas can disagree — the highest-version copy
+        is the acked latest write, so gather all answers and keep it."""
         last: Exception = OSError("empty up set")
         enoent = 0
         reachable = 0
+        best = None
+        best_v = ""
+        agree = 0
         for osd in up:
             try:
                 got = self.msgr.call(
@@ -174,18 +190,57 @@ class Client(MapFollower):
                 continue
             reachable += 1
             if "data" in got:
-                return bytes.fromhex(got["data"])[:got["size"]]
-            if got.get("error") == "enoent":
+                v = got.get("v") or ""
+                if best is None or v > best_v:
+                    best = bytes.fromhex(got["data"])[:got["size"]]
+                    best_v = v
+                    agree = 1
+                elif v == best_v:
+                    agree += 1
+                # two copies agreeing on the newest version seen is
+                # proof enough of freshness — the healthy path stops
+                # after 2 RPCs instead of querying every replica
+                if agree >= 2:
+                    return best
+            elif got.get("error") == "enoent":
                 enoent += 1
+        if best is not None:
+            return best
         if reachable and enoent == reachable:
             raise ObjectNotFound(oid)
         raise last
+
+    def delete(self, pool_id: int, oid: str, retries: int = 3) -> None:
+        """Tombstoned delete: peering propagates it over older writes
+        (the reference's log-entry DELETE semantics)."""
+        from ..common.version import make_version
+
+        v = make_version(self.epoch)
+        for attempt in range(retries):
+            pool, ps, up = self._up(pool_id, oid)
+            try:
+                for osd in {o for o in up
+                            if o >= 0 and o in self.osd_addrs}:
+                    got = self.msgr.call(
+                        self.osd_addrs[osd],
+                        {"type": "obj_delete", "pool": pool_id,
+                         "ps": ps, "oid": oid, "v": v}, timeout=10)
+                    if not got.get("ok"):
+                        raise OSError(f"obj_delete on osd.{osd}: "
+                                      f"{got}")
+                return
+            except (TimeoutError, OSError, KeyError):
+                if attempt + 1 == retries:
+                    raise
+                time.sleep(0.3)
+                self.refresh_map()
 
     def _read_ec(self, pool_id, ps, oid, up, code) -> bytes:
         """Gather any k shards (degraded reads ride the same path the
         reference's objects_read_and_reconstruct does)."""
         k = code.get_data_chunk_count()
         chunks: Dict[int, np.ndarray] = {}
+        vers: Dict[int, str] = {}
         size = None
         enoent = 0
         reachable = 0
@@ -201,8 +256,19 @@ class Client(MapFollower):
                 continue
             reachable += 1
             if "data" in got:
+                v = got.get("v") or ""
+                if vers and v != max(vers.values()):
+                    # mixed versions mid-reconciliation: chunks from
+                    # different writes never decode together — keep
+                    # only the newest write's shards
+                    if any(v > hv for hv in vers.values()):
+                        chunks.clear()
+                        vers.clear()
+                    else:
+                        continue  # stale shard: unusable for decode
                 chunks[pos] = np.frombuffer(
                     bytes.fromhex(got["data"]), np.uint8)
+                vers[pos] = v
                 size = got["size"]
             elif got.get("error") == "enoent":
                 enoent += 1

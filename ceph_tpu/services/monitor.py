@@ -40,7 +40,10 @@ class Monitor:
         self.ctx = ctx
         self.log = ctx.logger("mon")
         self.map = osdmap
-        self.msgr = Messenger("mon", host, port, keyring=keyring)
+        # lossless policy: mon↔mon quorum traffic and mon↔osd control
+        # frames are sequenced and replayed across reconnects
+        self.msgr = Messenger("mon", host, port, keyring=keyring,
+                              lossless=True)
         self.addr: Addr = self.msgr.addr
         self.store_dir = store_dir
         self._epochs: Dict[int, str] = {}  # epoch -> map json
@@ -79,6 +82,7 @@ class Monitor:
                      ("mark_down", self._fwd(self._h_mark_down)),
                      ("mark_out", self._fwd(self._h_mark_out)),
                      ("pool_create", self._fwd(self._h_pool_create)),
+                     ("pg_temp_set", self._fwd(self._h_pg_temp_set)),
                      ("ec_profile_set",
                       self._fwd(self._h_ec_profile_set)),
                      ("status", self._h_status)):
@@ -370,6 +374,24 @@ class Monitor:
             self.map.osd_weight[osd] = 0
             self._auto_out.pop(osd, None)  # admin out sticks
         return {"epoch": self._commit(f"osd.{osd} out")}
+
+    def _h_pg_temp_set(self, msg: Dict) -> Dict:
+        """Primary-requested acting override (OSDMonitor pg_temp flow):
+        keeps a PG served by its data holders while the new up set
+        backfills; an empty list clears the override."""
+        pgid = (int(msg["pool"]), int(msg["ps"]))
+        osds = [int(o) for o in msg.get("osds", [])]
+        with self._lock:
+            cur = self.map.pg_temp.get(pgid)
+            if osds:
+                if cur == osds:
+                    return {"epoch": self.map.epoch}
+                self.map.pg_temp[pgid] = osds
+            else:
+                if cur is None:
+                    return {"epoch": self.map.epoch}
+                del self.map.pg_temp[pgid]
+        return {"epoch": self._commit(f"pg_temp {pgid}")}
 
     def _h_pool_create(self, msg: Dict) -> Dict:
         pool_id = int(msg["pool_id"])

@@ -1,19 +1,32 @@
 """OSD service — the storage daemon analogue.
 
 The role of src/osd (OSD.cc dispatch + PrimaryLogPG + ECBackend),
-single-host scale: MemStore-backed shard storage per PG collection,
-EC-positional shard writes/reads (the ECBackend sub-op surface,
-ECBackend.cc:934/1015), mon boot + heartbeats (ceph_osd.cc:544), map
-subscriptions, and the mark-down→remap→recover flow: on every map
-epoch the service scans the PGs it serves, and backfills any shard it
-should hold but doesn't by fetching surviving shards from peers and
-EC-decoding (ECBackend::recover_object / continue_recovery_op shape,
-:757/589 — minimum_to_decode, fetch, decode, store).
+single-host scale: MemStore/WALStore-backed shard storage per PG
+collection, EC-positional shard writes/reads (the ECBackend sub-op
+surface, ECBackend.cc:934/1015), mon boot + heartbeats
+(ceph_osd.cc:544), map subscriptions, and primary-driven peering +
+recovery.
 
-Every PG collection keeps a PG log object (omap seq → op record) —
-the PGLog analogue that makes writes auditable and recovery
-explainable (SURVEY §5 checkpoint row); backfill consults the peer's
-object listing (the backfill path) with the log as provenance.
+Peering (the PeeringState.cc / PGLog.h role, redesigned around
+versioned objects instead of a log-offset state machine): every write
+carries a totally-ordered version (map epoch + timestamp, identical on
+every shard of the object), and every PG keeps a version-keyed log
+with delete tombstones.  On each map change the PG's primary collects
+``pg_info`` (last_update + per-object version map, folded from the
+log) from every reachable member of the up and acting sets, merges
+them into the authoritative per-object state — exactly the result the
+reference reaches by electing the authoritative log and merging
+divergent entries (PeeringState::choose_acting /
+PGLog::merge_log) — computes each member's missing set, and drives
+recovery: pull what the primary lacks, push what replicas lack,
+propagate deletes.  Divergent histories (A took writes while B was
+down, then roles flipped) reconcile to newest-version-wins, which the
+reference guarantees through past-intervals + log election.
+
+While the primary is itself behind, it installs a ``pg_temp`` overlay
+at the monitor mapping the PG to the best-covered holder
+(OSDMap.cc:2590 acting override) so reads keep being served, and
+clears it once clean — the serving-continuity half of peering.
 """
 
 from __future__ import annotations
@@ -29,6 +42,9 @@ from ..msg.messenger import Addr, Messenger
 from ..os.memstore import MemStore
 from ..os.objectstore import Transaction
 from ..osdmap.osdmap import OSDMap, POOL_TYPE_ERASURE
+
+
+from ..common.version import NULL_VERSION, make_version
 
 
 def pg_cid(pool_id: int, ps: int) -> str:
@@ -51,8 +67,14 @@ class OSDService(MapFollower):
         # everything from peers (the reference's restart-replay flow)
         self.data_dir = data_dir
         self.store = self._mount()
-        self.msgr = Messenger(f"osd.{osd_id}", host, port,
-                              keyring=keyring)
+        # lossless policy (osd↔osd sub-ops survive reconnects) and the
+        # per-type byte throttle bounding in-flight client write bytes
+        # (the osd_client_message_size_cap role, ceph_osd.cc:582-588)
+        self.msgr = Messenger(
+            f"osd.{osd_id}", host, port, keyring=keyring,
+            lossless=True,
+            throttles={"shard_write": Throttle(
+                "msgr-write-bytes", 64 << 20)})
         self.addr = self.msgr.addr
         self.map: Optional[OSDMap] = None
         self.epoch = 0
@@ -66,9 +88,13 @@ class OSDService(MapFollower):
         self._recover_wake = threading.Event()
         self.backfill_throttle = Throttle(
             "backfill", ctx.conf["osd_max_backfills"])
+        from ..common.op_queue import OpScheduler
         from ..common.op_tracker import OpTracker
 
         self.optracker = OpTracker()
+        # dmClock QoS at the store door: client vs recovery vs scrub
+        # ops are served in tag order by a small worker pool
+        self.sched = OpScheduler(n_workers=2)
         self.pc = ctx.perf.create(f"osd.{osd_id}")
         for key in ("ops_w", "ops_r", "recovered_objects",
                     "map_epochs"):
@@ -77,8 +103,11 @@ class OSDService(MapFollower):
         for t, h in (("shard_write", self._h_shard_write),
                      ("shard_read", self._h_shard_read),
                      ("pg_list", self._h_pg_list),
+                     ("pg_info", self._h_pg_info),
                      ("pg_scrub", self._h_pg_scrub),
                      ("shard_remove", self._h_shard_remove),
+                     ("obj_delete", self._h_obj_delete),
+                     ("pg_poke", self._h_pg_poke),
                      ("map_update", self._h_map_update),
                      ("map_inc", self._h_map_inc),
                      ("status", self._h_status)):
@@ -132,6 +161,7 @@ class OSDService(MapFollower):
     def shutdown(self) -> None:
         self._running = False
         self._recover_wake.set()
+        self.sched.shutdown()
         self.msgr.shutdown()
         try:
             self._flush()
@@ -169,33 +199,61 @@ class OSDService(MapFollower):
         return code
 
     # -- op handlers (the ECBackend sub-op surface) --------------------
+    def _qos_class(self, msg: Dict) -> str:
+        cls = msg.get("qos_class")
+        return cls if cls in ("client", "recovery", "scrub") \
+            else "client"
+
     def _h_shard_write(self, msg: Dict) -> Dict:
+        return self.sched.submit(self._qos_class(msg),
+                                 lambda: self._do_shard_write(msg))
+
+    def _do_shard_write(self, msg: Dict) -> Dict:
+        import json as _json
+
         from ..ec.stripe import crc32c
 
         cid = pg_cid(msg["pool"], msg["ps"])
+        v = msg.get("v") or make_version(self.epoch)
         oid = f"{msg['oid']}.s{msg['shard']}"
         with self.optracker.create(
                 "osd_op", f"write {cid}/{oid} from "
                           f"{msg.get('frm')}") as op:
-            txn = Transaction()
-            if not self.store.collection_exists(cid):
-                txn.create_collection(cid)
-            data = bytes.fromhex(msg["data"])
-            txn.write(cid, oid, 0, data)
-            txn.setattr(cid, oid, "size", str(msg["size"]).encode())
-            txn.setattr(cid, oid, "crc", str(crc32c(data)).encode())
-            seq = str(time.time_ns())
-            txn.omap_setkeys(cid, "pglog", {
-                seq: f'{{"op":"write","oid":"{msg["oid"]}",'
-                     f'"shard":{msg["shard"]},"epoch":{self.epoch}}}'
-                     .encode()})
-            op.mark_event("queued_for_store")
-            self.store.queue_transaction(txn)
+            with self._lock:
+                # a newer version (a divergent-history reconciliation
+                # or a racing later write) must never be clobbered by
+                # an older one arriving late
+                cur = self.store.getattr(cid, oid, "v") \
+                    if self.store.collection_exists(cid) else None
+                if cur is not None and cur.decode() > v:
+                    return {"ok": True, "superseded": True,
+                            "epoch": self.epoch}
+                txn = Transaction()
+                if not self.store.collection_exists(cid):
+                    txn.create_collection(cid)
+                data = bytes.fromhex(msg["data"])
+                txn.write(cid, oid, 0, data)
+                txn.setattr(cid, oid, "size",
+                            str(msg["size"]).encode())
+                txn.setattr(cid, oid, "crc",
+                            str(crc32c(data)).encode())
+                txn.setattr(cid, oid, "v", v.encode())
+                txn.omap_setkeys(cid, "pglog", {
+                    f"{v}|{msg['shard']}": _json.dumps(
+                        {"op": "write", "oid": msg["oid"],
+                         "shard": msg["shard"], "v": v,
+                         "size": msg["size"]}).encode()})
+                op.mark_event("queued_for_store")
+                self.store.queue_transaction(txn)
             op.mark_event("commit")
             self.pc.inc("ops_w")
         return {"ok": True, "epoch": self.epoch}
 
     def _h_shard_read(self, msg: Dict) -> Dict:
+        return self.sched.submit(self._qos_class(msg),
+                                 lambda: self._do_shard_read(msg))
+
+    def _do_shard_read(self, msg: Dict) -> Dict:
         cid = pg_cid(msg["pool"], msg["ps"])
         oid = f"{msg['oid']}.s{msg['shard']}"
         with self.optracker.create("osd_op",
@@ -205,8 +263,99 @@ class OSDService(MapFollower):
             except KeyError:
                 return {"error": "enoent"}
             size = self.store.getattr(cid, oid, "size") or b"0"
+            ver = self.store.getattr(cid, oid, "v") or b""
             self.pc.inc("ops_r")
-            return {"data": data.hex(), "size": int(size)}
+            return {"data": data.hex(), "size": int(size),
+                    "v": ver.decode()}
+
+    def _h_obj_delete(self, msg: Dict) -> Dict:
+        """Remove every local shard of an object and tombstone the
+        log, so the delete wins over older writes at peering time."""
+        import json as _json
+
+        cid = pg_cid(msg["pool"], msg["ps"])
+        v = msg.get("v") or make_version(self.epoch)
+        with self._lock:
+            txn = Transaction()
+            if not self.store.collection_exists(cid):
+                txn.create_collection(cid)
+            else:
+                prefix = f"{msg['oid']}.s"
+                for name in self.store.list_objects(cid):
+                    if not name.startswith(prefix):
+                        continue
+                    # same newer-wins guard as the write path: a stale
+                    # delete (late retry racing a newer put) must not
+                    # clobber the newer write's shards — the tombstone
+                    # still logs, and version merge orders them
+                    cur = self.store.getattr(cid, name, "v")
+                    if cur is not None and cur.decode() > v:
+                        continue
+                    txn.remove(cid, name)
+            txn.omap_setkeys(cid, "pglog", {
+                f"{v}|d": _json.dumps(
+                    {"op": "delete", "oid": msg["oid"],
+                     "v": v}).encode()})
+            self.store.queue_transaction(txn)
+        return {"ok": True, "epoch": self.epoch}
+
+    def _pg_local_info(self, pool_id: int, ps: int) -> Dict:
+        """Fold the PG log + store into the pg_info_t this OSD reports
+        during peering: last_update, and per object its newest logged
+        version, tombstone flag, size, and ``shards`` — which shard
+        POSITIONS this OSD actually holds and at which version.  The
+        position map is what makes peering correct across remaps: an
+        EC member that moved from position 3 to 2 still holds (and can
+        serve) its old s3 while missing s2."""
+        import json as _json
+
+        cid = pg_cid(pool_id, ps)
+        objects: Dict[str, Dict] = {}
+        last_update = NULL_VERSION
+        if self.store.collection_exists(cid):
+            for key, raw in sorted(
+                    self.store.omap_get(cid, "pglog").items()):
+                try:
+                    rec = _json.loads(raw.decode())
+                except ValueError:
+                    continue
+                v = rec.get("v", NULL_VERSION)
+                oid = rec.get("oid")
+                if oid is None:
+                    continue
+                cur = objects.get(oid)
+                if cur is None or v >= cur["v"]:
+                    objects[oid] = {
+                        "v": v,
+                        "deleted": rec.get("op") == "delete",
+                        "size": rec.get("size", 0), "shards": {}}
+                if v > last_update:
+                    last_update = v
+            # what the store actually holds, per position and version
+            # (the log may claim shards scrub-repair dropped, and may
+            # miss objects imported without log entries)
+            for name in self.store.list_objects(cid):
+                if name == "pglog" or ".s" not in name:
+                    continue
+                oid, _, pos = name.rpartition(".s")
+                ver = self.store.getattr(cid, name, "v")
+                vpos = ver.decode() if ver else NULL_VERSION
+                if oid not in objects:
+                    size = self.store.getattr(cid, name, "size") \
+                        or b"0"
+                    objects[oid] = {"v": vpos, "deleted": False,
+                                    "size": int(size), "shards": {}}
+                objects[oid]["shards"][pos] = vpos
+        return {"osd": self.id, "last_update": last_update,
+                "objects": objects}
+
+    def _h_pg_info(self, msg: Dict) -> Dict:
+        return self._pg_local_info(int(msg["pool"]), int(msg["ps"]))
+
+    def _h_pg_poke(self, _msg: Dict) -> None:
+        """A peer lost a shard (scrub repair) or wants re-peering."""
+        self._recover_wake.set()
+        return None
 
     def _h_pg_list(self, msg: Dict) -> Dict:
         cid = pg_cid(msg["pool"], msg["ps"])
@@ -220,6 +369,10 @@ class OSDService(MapFollower):
         return {"objects": out}
 
     def _h_pg_scrub(self, msg: Dict) -> Dict:
+        return self.sched.submit("scrub",
+                                 lambda: self._do_pg_scrub(msg))
+
+    def _do_pg_scrub(self, msg: Dict) -> Dict:
         """Deep scrub of one PG: recompute every local shard's crc32c
         and compare with the stored write-time digest (the
         HashInfo-backed scrub of the reference's deep-scrub flow)."""
@@ -243,13 +396,23 @@ class OSDService(MapFollower):
 
     def _h_shard_remove(self, msg: Dict) -> Dict:
         """Drop a (corrupt) shard so recovery rebuilds it — the repair
-        half of scrub (test-erasure-eio.sh flow)."""
+        half of scrub (test-erasure-eio.sh flow).  Recovery is
+        primary-driven, so poke the PG's primary to re-peer."""
         cid = pg_cid(msg["pool"], msg["ps"])
         name = f"{msg['oid']}.s{msg['shard']}"
         if self.store.stat(cid, name) is not None:
             self.store.queue_transaction(
                 Transaction().remove(cid, name))
         self._recover_wake.set()
+        with self._lock:
+            m = self.map
+        if m is not None:
+            up, _p, _a, _ap = m.pg_to_up_acting_osds(
+                int(msg["pool"]), int(msg["ps"]))
+            prim = next((o for o in up if self._alive(o)), None)
+            if prim is not None and prim != self.id:
+                self.msgr.send(self.osd_addrs[prim],
+                               {"type": "pg_poke"})
         return {"ok": True}
 
     def _h_status(self, _msg: Dict) -> Dict:
@@ -257,6 +420,8 @@ class OSDService(MapFollower):
             return {"osd": self.id, "epoch": self.epoch,
                     "collections": self.store.list_collections(),
                     "perf": self.pc.dump(),
+                    "qos_served": dict(self.sched.served),
+                    "qos_depths": self.sched.depths(),
                     "historic_ops": self.optracker.dump_historic_ops()}
 
     # -- heartbeats ----------------------------------------------------
@@ -286,8 +451,8 @@ class OSDService(MapFollower):
                 retry_pending = True  # peers may come back; retry
 
     def _alive(self, osd: int) -> bool:
-        return self.map is not None and self.map.is_up(osd) \
-            and osd in self.osd_addrs
+        return osd >= 0 and self.map is not None \
+            and self.map.is_up(osd) and osd in self.osd_addrs
 
     def _check_recovery(self) -> None:
         with self._lock:
@@ -296,107 +461,229 @@ class OSDService(MapFollower):
             return
         for pool_id, pool in m.pools.items():
             for ps in range(pool.pg_num):
-                up, _p, _a, _ap = m.pg_to_up_acting_osds(pool_id, ps)
-                if self.id not in up:
-                    continue
-                self._recover_pg(m, pool_id, pool, ps, up)
+                up, _p, acting, _ap = m.pg_to_up_acting_osds(pool_id,
+                                                             ps)
+                members = [o for o in up if self._alive(o)]
+                if not members or members[0] != self.id:
+                    continue  # peering + recovery are the primary's job
+                self._peer_pg(m, pool_id, pool, ps, up, acting)
 
-    def _recover_pg(self, m, pool_id: int, pool, ps: int,
-                    up: List[int]) -> None:
+    # -- peering (PeeringState / PGLog roles) --------------------------
+    def _peer_pg(self, m, pool_id: int, pool, ps: int,
+                 up: List[int], acting: List[int]) -> None:
+        """Collect infos, merge to the authoritative per-object state,
+        drive pulls/pushes/deletes, manage the pg_temp overlay."""
         cid = pg_cid(pool_id, ps)
         code = self._code_for(pool)
-        # replicated pools store the full object as shard 0 on every
-        # replica; EC pools are positional
-        shard = up.index(self.id) if code is not None else 0
-        have: Set[str] = set()
-        if self.store.collection_exists(cid):
-            for name in self.store.list_objects(cid):
-                if name.endswith(f".s{shard}"):
-                    have.add(name.rpartition(".s")[0])
-        # authoritative listing from any live peer of this pg
-        peers = [o for o in up if o != self.id and self._alive(o)]
-        missing: Dict[str, int] = {}
-        for peer in peers:
+        # query every reachable member of up AND acting (the acting set
+        # holds the data during a backfill interval — the past-interval
+        # members that matter at this harness's scale)
+        members = sorted({o for o in (list(up) + list(acting))
+                          if o == self.id or self._alive(o)})
+        infos: Dict[int, Dict] = {}
+        for o in members:
+            if o == self.id:
+                infos[o] = self._pg_local_info(pool_id, ps)
+                continue
             try:
-                got = self.msgr.call(
-                    self.osd_addrs[peer],
-                    {"type": "pg_list", "pool": pool_id, "ps": ps},
+                infos[o] = self.msgr.call(
+                    self.osd_addrs[o],
+                    {"type": "pg_info", "pool": pool_id, "ps": ps},
                     timeout=5)
             except (TimeoutError, OSError):
                 continue
-            for oid, size in got.get("objects", {}).items():
-                if oid not in have:
-                    missing[oid] = max(missing.get(oid, 0), size)
-        if not missing:
-            return
-        for oid, size in missing.items():
+        # merge: newest version wins per object (delete tombstones
+        # included) — the result of authoritative-log election + merge
+        merged: Dict[str, Dict] = {}
+        for o, info in infos.items():
+            for oid, rec in info.get("objects", {}).items():
+                cur = merged.get(oid)
+                if cur is None or rec["v"] > cur["v"]:
+                    merged[oid] = dict(rec)
+        my = infos.get(self.id, {}).get("objects", {})
+
+        def shard_v(osd: int, oid: str, pos: int) -> str:
+            return infos.get(osd, {}).get("objects", {}) \
+                .get(oid, {}).get("shards", {}) \
+                .get(str(pos), NULL_VERSION)
+
+        # serving continuity: if this (new) primary is missing data,
+        # point the PG at the best-covered holder via pg_temp while we
+        # catch up
+        i_am_behind = any(
+            (not rec["deleted"])
+            and shard_v(self.id, oid, 0) < rec["v"]
+            for oid, rec in merged.items()) if code is None else False
+        if i_am_behind and code is None:
+            best = max((o for o in infos if o != self.id),
+                       key=lambda o: infos[o].get("last_update",
+                                                  NULL_VERSION),
+                       default=None)
+            if best is not None and \
+                    infos[best].get("last_update", NULL_VERSION) > \
+                    infos.get(self.id, {}).get("last_update",
+                                               NULL_VERSION):
+                # full acting set, best-covered holder first: reads
+                # find the data, and writes during backfill keep the
+                # pool's replication factor (and keep landing on up
+                # members, so the next peering round sees them)
+                acting_set = [best] + [o for o in up
+                                       if o != best and self._alive(o)]
+                self._set_pg_temp(pool_id, ps, acting_set)
+
+        clean = True
+        for oid, rec in merged.items():
+            if rec["deleted"]:
+                # propagate the tombstone: anyone still holding an
+                # older live version drops it
+                for o, info in infos.items():
+                    lrec = info.get("objects", {}).get(oid)
+                    if lrec and not lrec.get("deleted") \
+                            and lrec["v"] < rec["v"]:
+                        self._send_delete(pool_id, ps, o, oid,
+                                          rec["v"])
+                continue
             if not self.backfill_throttle.get(timeout=5):
                 return
             try:
-                self._recover_object(m, pool_id, pool, ps, up, shard,
-                                     oid, size, code)
+                clean &= self._recover_object(
+                    m, pool_id, pool, ps, up, oid, rec, infos,
+                    shard_v, code)
             finally:
                 self.backfill_throttle.put()
+        if clean:
+            self._set_pg_temp(pool_id, ps, [])
 
-    def _recover_object(self, m, pool_id, pool, ps, up, shard, oid,
-                        size, code) -> None:
-        """ECBackend::recover_object: fetch survivors, decode, store."""
-        cid = pg_cid(pool_id, ps)
-        if code is None:
-            # replicated: copy the full object from any live peer
-            for peer in up:
-                if peer == self.id or not self._alive(peer):
-                    continue
-                got = self.msgr.call(
-                    self.osd_addrs[peer],
-                    {"type": "shard_read", "pool": pool_id, "ps": ps,
-                     "oid": oid, "shard": 0}, timeout=5)
-                if "data" in got:
-                    self._store_shard(cid, oid, 0, bytes.fromhex(
-                        got["data"]), got["size"])
-                    self.pc.inc("recovered_objects")
-                    return
-            return
+    def _send_delete(self, pool_id, ps, osd, oid, v) -> None:
+        msg = {"type": "obj_delete", "pool": pool_id, "ps": ps,
+               "oid": oid, "v": v}
+        try:
+            if osd == self.id:
+                self._h_obj_delete(msg)
+            else:
+                self.msgr.call(self.osd_addrs[osd], msg, timeout=5)
+        except (TimeoutError, OSError):
+            pass
+
+    def _recover_object(self, m, pool_id, pool, ps, up, oid, rec,
+                        infos, shard_v, code) -> bool:
+        """Primary-driven object recovery at the authoritative version
+        (ECBackend::recover_object / ReplicatedBackend push-pull):
+        returns True when every up member holds its shard of oid@v.
+        Everything is keyed by shard POSITION — a member that moved
+        positions in a remap still serves the old position's shard as
+        a pull source while needing its new one."""
         import numpy as np
 
-        n = code.get_chunk_count()
-        chunks: Dict[int, np.ndarray] = {}
-        for pos, peer in enumerate(up):
-            if len(chunks) >= code.get_data_chunk_count():
-                break
-            if peer == self.id or not self._alive(peer):
-                continue
-            try:
-                got = self.msgr.call(
-                    self.osd_addrs[peer],
-                    {"type": "shard_read", "pool": pool_id, "ps": ps,
-                     "oid": oid, "shard": pos}, timeout=5)
-            except (TimeoutError, OSError):
-                continue
-            if "data" in got:
-                chunks[pos] = np.frombuffer(
-                    bytes.fromhex(got["data"]), np.uint8)
-        if len(chunks) < code.get_data_chunk_count():
-            self.log.derr(f"pg {cid} {oid}: not enough shards to "
-                          f"recover ({len(chunks)})")
-            return
-        out = code.decode({shard}, chunks)
-        self._store_shard(cid, oid, shard,
-                          np.asarray(out[shard], np.uint8).tobytes(),
-                          size)
-        self.pc.inc("recovered_objects")
-        self.log.dout(5, f"recovered {cid}/{oid} shard {shard}")
+        cid = pg_cid(pool_id, ps)
+        v, size = rec["v"], rec.get("size", 0)
 
-    def _store_shard(self, cid: str, oid: str, shard: int,
-                     data: bytes, size: int) -> None:
-        txn = Transaction()
-        if not self.store.collection_exists(cid):
-            txn.create_collection(cid)
-        name = f"{oid}.s{shard}"
-        txn.write(cid, name, 0, data)
-        txn.setattr(cid, name, "size", str(size).encode())
-        txn.omap_setkeys(cid, "pglog", {
-            str(time.time_ns()):
-                f'{{"op":"recover","oid":"{oid}","shard":{shard},'
-                f'"epoch":{self.epoch}}}'.encode()})
-        self.store.queue_transaction(txn)
+        def read_pos(pos: int):
+            """Fetch shard ``pos``@v from any member that holds it."""
+            for o in infos:
+                if shard_v(o, oid, pos) != v:
+                    continue
+                if o == self.id:
+                    try:
+                        return np.frombuffer(
+                            self.store.read(cid, f"{oid}.s{pos}"),
+                            np.uint8)
+                    except KeyError:
+                        continue
+                try:
+                    got = self.msgr.call(
+                        self.osd_addrs[o],
+                        {"type": "shard_read", "pool": pool_id,
+                         "ps": ps, "oid": oid, "shard": pos,
+                         "qos_class": "recovery"},
+                        timeout=5)
+                except (TimeoutError, OSError):
+                    continue
+                if got.get("v") == v and "data" in got:
+                    return np.frombuffer(bytes.fromhex(got["data"]),
+                                         np.uint8)
+            return None
+
+        if code is None:
+            need = [o for o in up
+                    if shard_v(o, oid, 0) != v]
+            if not need:
+                return True
+            data = read_pos(0)
+            if data is None:
+                self.log.derr(f"pg {cid} {oid}@{v}: no reachable "
+                              f"holder")
+                return False
+            ok = True
+            for o in need:
+                if o != self.id and not self._alive(o):
+                    ok = False
+                    continue
+                self._push_shard(pool_id, ps, o, oid, 0,
+                                 data.tobytes(), size, v)
+            self.pc.inc("recovered_objects")
+            return ok
+
+        # EC: each up member needs the shard of ITS position.  Gather
+        # any k positions at version v (direct moves included), then
+        # reconstruct whatever positions lack a holder (the reference
+        # regenerates from k reads the same way).
+        n = code.get_chunk_count()
+        k = code.get_data_chunk_count()
+        need = [(pos, o) for pos, o in enumerate(up)
+                if shard_v(o, oid, pos) != v]
+        if not need:
+            return True
+        chunks: Dict[int, np.ndarray] = {}
+        for pos in range(n):
+            if len(chunks) >= k:
+                break
+            got = read_pos(pos)
+            if got is not None:
+                chunks[pos] = got
+        if len(chunks) < k:
+            self.log.derr(f"pg {cid} {oid}@{v}: only {len(chunks)} of "
+                          f"{k} shards reachable")
+            return False
+        want = {pos for pos, _o in need}
+        out = code.decode(want, chunks)
+        ok = True
+        for pos, o in need:
+            if o != self.id and not self._alive(o):
+                ok = False
+                continue
+            self._push_shard(
+                pool_id, ps, o, oid, pos,
+                np.asarray(out[pos], np.uint8).tobytes(), size, v)
+        self.pc.inc("recovered_objects")
+        self.log.dout(5, f"recovered {cid}/{oid}@{v}")
+        return ok
+
+    def _push_shard(self, pool_id, ps, osd, oid, shard, data, size,
+                    v) -> None:
+        msg = {"type": "shard_write", "pool": pool_id, "ps": ps,
+               "oid": oid, "shard": shard, "data": data.hex(),
+               "size": size, "v": v, "qos_class": "recovery"}
+        try:
+            if osd == self.id:
+                self._h_shard_write(msg)
+            else:
+                self.msgr.call(self.osd_addrs[osd], msg, timeout=10)
+        except (TimeoutError, OSError):
+            pass
+
+    def _set_pg_temp(self, pool_id: int, ps: int,
+                     osds: List[int]) -> None:
+        """Install/clear the acting override at the monitor; no-op when
+        the map already agrees (avoids commit churn every pass)."""
+        with self._lock:
+            cur = self.map.pg_temp.get((pool_id, ps), []) \
+                if self.map is not None else []
+        if list(cur) == list(osds):
+            return
+        try:
+            self.mon_call({"type": "pg_temp_set", "pool": pool_id,
+                           "ps": ps, "osds": list(osds)}, timeout=5,
+                          tries=1)
+        except Exception as e:
+            self.log.dout(5, f"pg_temp_set failed: {e}")

@@ -1,0 +1,217 @@
+"""rados bench — the cluster throughput/latency harness.
+
+The role of `rados bench` (src/tools/rados/rados.cc:107) and its
+engine ObjBencher (src/common/obj_bencher.cc): drive a cluster with N
+concurrent writers/readers for a fixed duration and report throughput,
+IOPS, and latency percentiles.  Works against any mon address
+(a running cluster) or self-hosts a MiniCluster for one-shot runs.
+
+CLI:
+    python -m ceph_tpu.tools.rados_bench write --seconds 5 \
+        --concurrent 8 --object-size 65536 [--ec]
+    ... seq | rand                     (read back what write created)
+
+Output: one human summary on stderr and ONE JSON line on stdout —
+the same one-line contract bench.py uses.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class BenchResult:
+    def __init__(self, op: str, object_size: int):
+        self.op = op
+        self.object_size = object_size
+        self.latencies: List[float] = []
+        self.errors = 0
+        self.wall = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, dt: float) -> None:
+        with self._lock:
+            self.latencies.append(dt)
+
+    def add_error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+    def summary(self) -> Dict:
+        lat = sorted(self.latencies)
+        n = len(lat)
+        if n == 0:
+            return {"op": self.op, "ops": 0, "errors": self.errors}
+        total_bytes = n * self.object_size
+        return {
+            "op": self.op,
+            "ops": n,
+            "errors": self.errors,
+            "seconds": round(self.wall, 3),
+            "iops": round(n / self.wall, 1) if self.wall else None,
+            "mb_per_sec": round(total_bytes / self.wall / 1e6, 2)
+            if self.wall else None,
+            "object_size": self.object_size,
+            "lat_avg_ms": round(1e3 * sum(lat) / n, 3),
+            "lat_min_ms": round(1e3 * lat[0], 3),
+            "lat_p50_ms": round(1e3 * lat[n // 2], 3),
+            "lat_p99_ms": round(1e3 * lat[min(n - 1,
+                                              (99 * n) // 100)], 3),
+            "lat_max_ms": round(1e3 * lat[-1], 3),
+            "lat_stddev_ms": round(
+                1e3 * statistics.pstdev(lat), 3) if n > 1 else 0.0,
+        }
+
+
+class ObjBencher:
+    """N concurrent workers against one pool through one client map
+    (each worker owns its own messenger-level concurrency through the
+    shared client; placements are computed client-side per op)."""
+
+    def __init__(self, client, pool_id: int,
+                 object_size: int = 1 << 16, concurrent: int = 8,
+                 prefix: Optional[str] = None):
+        self.client = client
+        self.pool_id = pool_id
+        self.object_size = object_size
+        self.concurrent = concurrent
+        self.prefix = prefix or f"benchmark_data_{time.time_ns()}"
+        self.written = 0
+
+    def _run(self, op: str, seconds: float, fn) -> BenchResult:
+        res = BenchResult(op, self.object_size)
+        stop = time.monotonic() + seconds
+        counter = [0]
+        clock = threading.Lock()
+
+        def worker(wid: int):
+            while time.monotonic() < stop:
+                with clock:
+                    i = counter[0]
+                    counter[0] += 1
+                t0 = time.perf_counter()
+                try:
+                    fn(i)
+                except Exception:
+                    res.add_error()
+                    continue
+                res.add(time.perf_counter() - t0)
+
+        t0 = time.monotonic()
+        ths = [threading.Thread(target=worker, args=(w,))
+               for w in range(self.concurrent)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        res.wall = time.monotonic() - t0
+        return res
+
+    def write(self, seconds: float) -> BenchResult:
+        blob = bytes(
+            (i * 131 + 17) & 0xFF for i in range(self.object_size))
+
+        def one(i: int) -> None:
+            self.client.put(self.pool_id, f"{self.prefix}_{i}", blob)
+
+        res = self._run("write", seconds, one)
+        self.written = res.summary().get("ops", 0) + res.errors
+        return res
+
+    def seq(self, seconds: float) -> BenchResult:
+        limit = max(1, self.written)
+
+        def one(i: int) -> None:
+            self.client.get(self.pool_id,
+                            f"{self.prefix}_{i % limit}",
+                            notfound_retries=0)
+
+        return self._run("seq", seconds, one)
+
+    def rand(self, seconds: float) -> BenchResult:
+        import random
+
+        limit = max(1, self.written)
+        rng = random.Random(42)
+
+        def one(i: int) -> None:
+            self.client.get(
+                self.pool_id,
+                f"{self.prefix}_{rng.randrange(limit)}",
+                notfound_retries=0)
+
+        return self._run("rand", seconds, one)
+
+
+def bench_minicluster(op: str = "write", seconds: float = 5.0,
+                      concurrent: int = 8, object_size: int = 1 << 16,
+                      n_osds: int = 4, ec: bool = False,
+                      pg_num: int = 16) -> Dict:
+    """One-shot: boot a MiniCluster, run write (then optionally a read
+    phase), return the summary dict."""
+    from ..common.config import Config
+    from ..services.cluster import MiniCluster
+
+    conf = Config()
+    conf.set("osd_heartbeat_interval", 0.5)
+    conf.set("osd_heartbeat_grace", 5.0)
+    cluster = MiniCluster(n_osds=n_osds, config=conf).start()
+    try:
+        if ec:
+            cluster.create_ec_pool(
+                1, "bench21", {"plugin": "jerasure",
+                               "technique": "reed_sol_van",
+                               "k": "2", "m": "1", "w": "8"},
+                pg_num=pg_num)
+        else:
+            cluster.create_replicated_pool(
+                1, pg_num=pg_num, size=min(3, n_osds))
+        cli = cluster.client("bench")
+        b = ObjBencher(cli, 1, object_size=object_size,
+                       concurrent=concurrent)
+        w = b.write(seconds)
+        out = {"write": w.summary()}
+        if op in ("seq", "rand"):
+            out[op] = getattr(b, op)(seconds).summary()
+        out["pool"] = "ec(2,1)" if ec else "replicated(size=" + \
+            str(min(3, n_osds)) + ")"
+        out["n_osds"] = n_osds
+        return out
+    finally:
+        cluster.shutdown()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="rados_bench")
+    ap.add_argument("op", choices=["write", "seq", "rand"])
+    ap.add_argument("--seconds", type=float, default=5.0)
+    ap.add_argument("--concurrent", type=int, default=8)
+    ap.add_argument("--object-size", type=int, default=1 << 16)
+    ap.add_argument("--osds", type=int, default=4)
+    ap.add_argument("--pg-num", type=int, default=16)
+    ap.add_argument("--ec", action="store_true",
+                    help="bench an EC(2,1) pool instead of replicated")
+    args = ap.parse_args(argv)
+
+    out = bench_minicluster(
+        op=args.op, seconds=args.seconds, concurrent=args.concurrent,
+        object_size=args.object_size, n_osds=args.osds, ec=args.ec,
+        pg_num=args.pg_num)
+    for phase, s in out.items():
+        if isinstance(s, dict):
+            print(f"# {phase}: {s.get('iops')} IOPS, "
+                  f"{s.get('mb_per_sec')} MB/s, avg "
+                  f"{s.get('lat_avg_ms')} ms, p99 "
+                  f"{s.get('lat_p99_ms')} ms", file=sys.stderr)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

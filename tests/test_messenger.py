@@ -1,0 +1,173 @@
+"""Messenger session layer: reconnect/replay, dedup, policies,
+throttles — the ProtocolV2 acceptance tests from the round-3 review.
+
+The headline test drops the TCP connection repeatedly under an
+in-flight op stream and asserts ZERO lost and ZERO duplicated ops.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.common.throttle import Throttle
+from ceph_tpu.msg.auth import Keyring
+from ceph_tpu.msg.messenger import Messenger, _send_frame
+
+
+def mk_pair(lossless=True, keyring=None, throttles=None):
+    server = Messenger("server", lossless=lossless, keyring=keyring,
+                       throttles=throttles)
+    client = Messenger("client-side", lossless=lossless,
+                       keyring=keyring)
+    server.start()
+    client.start()
+    return server, client
+
+
+def test_drop_connection_under_stream_zero_lost_zero_dup():
+    server, client = mk_pair()
+    seen = []
+    seen_lock = threading.Lock()
+
+    def h(msg):
+        with seen_lock:
+            seen.append(msg["n"])
+        return {"ok": True, "n": msg["n"]}
+
+    server.register("op", h)
+    errors = []
+    N, WRITERS = 60, 4
+
+    def writer(w):
+        for i in range(N):
+            n = w * N + i
+            try:
+                rep = client.call(server.addr,
+                                  {"type": "op", "n": n}, timeout=20)
+                assert rep.get("n") == n
+            except Exception as e:
+                errors.append((n, e))
+
+    ths = [threading.Thread(target=writer, args=(w,))
+           for w in range(WRITERS)]
+    for t in ths:
+        t.start()
+    # kill the transport repeatedly mid-stream
+    for _ in range(6):
+        time.sleep(0.15)
+        with client._conn_lock:
+            socks = list(client._conns.values())
+        for s in socks:
+            try:
+                s.close()  # RST from under the session layer
+            except OSError:
+                pass
+    for t in ths:
+        t.join()
+    try:
+        assert not errors, f"lost ops: {errors[:3]}"
+        assert sorted(seen) == list(range(N * WRITERS)), \
+            f"dups/gaps: {len(seen)} served vs {N * WRITERS}"
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_duplicate_sequenced_frame_not_reexecuted():
+    """A captured signed frame replayed verbatim must not re-run the
+    handler (the cephx seq-binding / ADVICE replay item)."""
+    kr = Keyring.generate()
+    server, client = mk_pair(keyring=kr)
+    calls = []
+    server.register("op", lambda m: calls.append(m["n"]) or
+                    {"ok": True})
+    try:
+        client.call(server.addr, {"type": "op", "n": 1}, timeout=10)
+        # capture the exact signed frame the session layer produced
+        sess = client._out[tuple(server.addr)]
+        with sess.buf_lock:
+            frames = list(sess.unacked.values())
+        if not frames:  # already acked: rebuild the same frame
+            frames = [client._sign({"type": "op", "n": 1, "_s": 1,
+                                    "_sess": client.session_id,
+                                    "frm": client.name})]
+        import socket as _socket
+
+        raw = _socket.create_connection(server.addr, timeout=5)
+        _send_frame(raw, frames[0])
+        time.sleep(0.5)
+        raw.close()
+        assert calls == [1], f"replay executed: {calls}"
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_tampered_frame_dropped():
+    kr = Keyring.generate()
+    server, client = mk_pair(keyring=kr)
+    calls = []
+    server.register("op", lambda m: calls.append(m["n"]) or
+                    {"ok": True})
+    try:
+        import socket as _socket
+
+        frame = client._sign({"type": "op", "n": 7, "_s": 1,
+                              "_sess": client.session_id,
+                              "frm": client.name})
+        frame["n"] = 8  # tamper after signing
+        raw = _socket.create_connection(server.addr, timeout=5)
+        _send_frame(raw, frame)
+        time.sleep(0.4)
+        raw.close()
+        assert calls == []
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_lossy_policy_unsequenced():
+    server, client = mk_pair(lossless=False)
+    got = []
+    server.register("op", lambda m: got.append(m.get("_s")) or
+                    {"ok": True})
+    try:
+        client.call(server.addr, {"type": "op"}, timeout=10)
+        assert got == [None]  # no sequence numbers on lossy frames
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_per_type_byte_throttle_bounds_inflight():
+    th = Throttle("t", 40_000)  # two ~17KB frames fit, three don't
+    server, client = mk_pair(throttles={"big": th})
+    inflight = []
+    peak = [0]
+    lk = threading.Lock()
+
+    def h(msg):
+        with lk:
+            inflight.append(1)
+            peak[0] = max(peak[0], len(inflight))
+        time.sleep(0.2)
+        with lk:
+            inflight.pop()
+        return {"ok": True}
+
+    server.register("big", h)
+    try:
+        blob = "x" * 16_000
+        ths = [threading.Thread(
+            target=lambda: client.call(
+                server.addr, {"type": "big", "d": blob}, timeout=20))
+            for _ in range(5)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        assert peak[0] <= 2, f"throttle admitted {peak[0]} at once"
+    finally:
+        client.shutdown()
+        server.shutdown()

@@ -1,0 +1,142 @@
+"""Peering: divergent-history reconciliation (the PeeringState/PGLog
+acceptance test from the round-3 review).
+
+Scenario: write with B down (only A has it); kill A, revive B, write
+more (divergent history on B at a higher epoch); revive A.  Peering
+must merge both logs — newest version wins per object, tombstones
+propagate — and every object must read its latest acked data, with
+both replicas converging to identical state.
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.services.client import ObjectNotFound
+from ceph_tpu.services.cluster import MiniCluster
+
+
+def fast_conf():
+    c = Config()
+    c.set("osd_heartbeat_interval", 0.2)
+    c.set("osd_heartbeat_grace", 1.0)
+    c.set("mon_osd_down_out_interval", 1.0)
+    return c
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    # persistent (WALStore) OSDs: a revived daemon remounts its data,
+    # which is what makes "divergent histories" possible at all
+    c = MiniCluster(n_osds=2, hosts=2, config=fast_conf(),
+                    data_dir=str(tmp_path)).start()
+    c.create_replicated_pool(1, pg_num=8, size=2)
+    yield c
+    c.shutdown()
+
+
+def _wait_converged(cluster, pool_id, expect, timeout=30.0):
+    """Every live OSD that the map assigns an object holds it at the
+    SAME newest version, and reads return the expected bytes."""
+    cli = cluster.client(f"conv{time.time_ns()}")
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            for oid, want in expect.items():
+                if want is None:
+                    with pytest.raises(ObjectNotFound):
+                        cli.get(pool_id, oid, notfound_retries=0)
+                else:
+                    assert cli.get(pool_id, oid) == want
+            # replica convergence: identical version xattrs everywhere
+            from ceph_tpu.services.client import object_to_ps
+            payload = cluster.mon_command({"type": "get_map"})
+            from ceph_tpu.osdmap.osdmap import OSDMap
+            m = OSDMap.from_dict(payload["map"])
+            pool = m.pools[pool_id]
+            for oid, want in expect.items():
+                ps = object_to_ps(oid) % pool.pg_num
+                cid = f"{pool_id}.{ps}"
+                up, _p, _a, _ap = m.pg_to_up_acting_osds(pool_id, ps)
+                vs = set()
+                for osd in up:
+                    svc = cluster.osds.get(osd)
+                    assert svc is not None
+                    if want is None:
+                        assert svc.store.stat(cid, f"{oid}.s0") \
+                            is None, f"{oid} not deleted on osd.{osd}"
+                    else:
+                        ver = svc.store.getattr(cid, f"{oid}.s0", "v")
+                        assert ver is not None, \
+                            f"{oid} missing on osd.{osd}"
+                        vs.add(ver)
+                if want is not None:
+                    assert len(vs) == 1, f"{oid} versions diverge"
+            return
+        except (AssertionError, Exception) as e:  # noqa: BLE001
+            last = e
+            time.sleep(0.3)
+    raise AssertionError(f"never converged: {last}")
+
+
+def test_divergent_histories_reconcile(cluster):
+    A, B = 0, 1
+    cli = cluster.client()
+
+    # interval 1: both up — baseline object
+    cli.put(1, "x", b"x-v1")
+    cli.put(1, "y", b"y-v1")
+
+    # interval 2: B down — writes land only on A
+    cluster.kill_osd(B)
+    cluster.wait_for_down(B, timeout=10)
+    time.sleep(1.5)  # let auto-out remap to [A]
+    cli.refresh_map()
+    cli.put(1, "x", b"x-v2-on-A")
+    cli.put(1, "only-a", b"a-data")
+
+    # interval 3: A down, B revived — divergent writes on B
+    cluster.kill_osd(A)
+    cluster.revive_osd(B)
+    cluster.wait_for_down(A, timeout=10)
+    cluster.wait_for_up(B, timeout=10)
+    time.sleep(1.5)
+    cli.refresh_map()
+    cli.put(1, "x", b"x-v3-on-B")       # newer than A's x-v2
+    cli.put(1, "only-b", b"b-data")
+    cli.delete(1, "y")                  # tombstone while A holds y-v1
+
+    # interval 4: A revived — both divergent logs must reconcile
+    cluster.revive_osd(A)
+    cluster.wait_for_up(A, timeout=10)
+
+    _wait_converged(cluster, 1, {
+        "x": b"x-v3-on-B",   # B's later write wins over A's
+        "only-a": b"a-data",  # A's solo write survives
+        "only-b": b"b-data",  # B's solo write survives
+        "y": None,            # the delete beats the older write
+    })
+
+
+def test_reads_survive_reconciliation_window(cluster):
+    """Every read during the reconciliation returns either nothing
+    stale-after-newer data: the version-aware read picks the newest
+    reachable copy the moment both replicas answer."""
+    A, B = 0, 1
+    cli = cluster.client()
+    cli.put(1, "w", b"w-v1")
+    cluster.kill_osd(B)
+    cluster.wait_for_down(B, timeout=10)
+    time.sleep(1.5)
+    cli.refresh_map()
+    cli.put(1, "w", b"w-v2")
+    cluster.revive_osd(B)
+    cluster.wait_for_up(B, timeout=10)
+    # from the instant B is back (holding stale w-v1), reads must
+    # never regress to v1
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        assert cli.get(1, "w") == b"w-v2"
+        time.sleep(0.1)
