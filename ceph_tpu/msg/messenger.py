@@ -439,7 +439,15 @@ class Messenger:
                 # healing happens in the background resync
                 self._drop(addr)
                 sess.synced = False
-                self._ensure_synced(addr)
+                try:
+                    self._ensure_synced(addr)
+                except (OSError, TimeoutError):
+                    if msg.get("tid") is not None:
+                        # the call is failing to its caller: a frame
+                        # left buffered would replay a dead op after
+                        # the peer returns (e.g. a stale pg_temp_set)
+                        sess.complete(seq)
+                    raise
             return seq
 
     def send(self, addr: Addr, msg: Dict) -> None:

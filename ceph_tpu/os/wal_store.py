@@ -30,15 +30,38 @@ from __future__ import annotations
 import os
 import struct
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..common.bincode import (DecodeError, Decoder, Encoder, decode_txn,
                               encode_txn)
 from .memstore import MemStore, _Object
 from .objectstore import ObjectStore, Transaction
 
-_MAGIC = 0x57414C31  # "WAL1"
+_MAGIC = 0x57414C31   # "WAL1": raw body
+_MAGIC_Z = 0x57414C5A  # "WALZ": compressed body (compressor name
+#                        prefixed to the payload, length-prefixed)
 _HDR = struct.Struct("<IQII")
+
+
+def _pack_body(body: bytes, comp) -> Tuple[int, bytes]:
+    """(magic, on-disk body): checkpoints/records run through the
+    compressor registry (the BlueStore per-pool compression role,
+    src/compressor) when one is configured."""
+    if comp is None or comp.name == "none":
+        return _MAGIC, body
+    packed = comp.compress(body)
+    tag = comp.name.encode()
+    return _MAGIC_Z, bytes([len(tag)]) + tag + packed
+
+
+def _unpack_body(magic: int, body: bytes) -> bytes:
+    if magic == _MAGIC:
+        return body
+    from ..common.compressor import Compressor
+
+    n = body[0]
+    name = body[1:1 + n].decode()
+    return Compressor(name).decompress(body[1 + n:])
 
 
 def _crc32c(data: bytes) -> int:
@@ -49,8 +72,14 @@ def _crc32c(data: bytes) -> int:
 
 class WALStore(ObjectStore):
     def __init__(self, path: str, checkpoint_every_bytes: int = 1 << 24,
-                 sync: bool = True):
+                 sync: bool = True, compression: str = "zlib"):
+        from ..common.compressor import Compressor
+
         self.path = path
+        # checkpoints compress through the registry (WAL records stay
+        # raw: their latency is the write ack path); mount reads both
+        # formats, so the option can change between runs
+        self._comp = Compressor(compression)
         self._mem = MemStore()
         self._wal_path = os.path.join(path, "wal.log")
         self._ckpt_path = os.path.join(path, "checkpoint")
@@ -186,10 +215,10 @@ class WALStore(ObjectStore):
                 enc.str_blob_map(o.xattr)
                 enc.str_blob_map(o.omap)
         enc.finish()
-        body = enc.bytes()
+        magic, body = _pack_body(enc.bytes(), self._comp)
         tmp = self._ckpt_path + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(_HDR.pack(_MAGIC, seq, len(body), _crc32c(body)))
+            f.write(_HDR.pack(magic, seq, len(body), _crc32c(body)))
             f.write(body)
             f.flush()
             os.fsync(f.fileno())
@@ -212,9 +241,10 @@ class WALStore(ObjectStore):
             return  # mkfs crashed mid-write; empty store
         magic, seq, ln, crc = _HDR.unpack_from(raw)
         body = raw[_HDR.size:_HDR.size + ln]
-        if magic != _MAGIC or len(body) != ln or _crc32c(body) != crc:
+        if magic not in (_MAGIC, _MAGIC_Z) or len(body) != ln \
+                or _crc32c(body) != crc:
             raise RuntimeError(f"corrupt checkpoint at {self._ckpt_path}")
-        dec = Decoder(body)
+        dec = Decoder(_unpack_body(magic, body))
         dec.start(1)
         got_seq = dec.u64()
         assert got_seq == seq

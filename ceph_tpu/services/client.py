@@ -210,6 +210,61 @@ class Client(MapFollower):
             raise ObjectNotFound(oid)
         raise last
 
+    def write(self, pool_id: int, oid: str, offset: int,
+              data: bytes, retries: int = 3) -> None:
+        """Partial (offset) write.  EC pools: a primary-coordinated
+        read-merge-encode op (the ECBackend start_rmw flow) — the
+        client sends ONE ec_write to the PG primary, which serializes
+        it under the PG lock.  Replicated pools: client-side RMW over
+        put (last-writer-wins at object granularity, like the
+        reference's replicated offset write under a single client)."""
+        for attempt in range(retries):
+            pool, ps, up = self._up(pool_id, oid)
+            code = self._code_for(pool)
+            try:
+                if code is None:
+                    try:
+                        base = self.get(pool_id, oid,
+                                        notfound_retries=0)
+                    except ObjectNotFound:
+                        base = b""
+                    size = max(len(base), offset + len(data))
+                    buf = bytearray(size)
+                    buf[:len(base)] = base
+                    buf[offset:offset + len(data)] = data
+                    self.put(pool_id, oid, bytes(buf))
+                    return
+                # same liveness rule as the server's primary check:
+                # first UP member, else the op targets a dead daemon
+                # the real primary would skip
+                prim = next((o for o in up
+                             if o >= 0 and o in self.osd_addrs
+                             and self.map.is_up(o)), None)
+                if prim is None:
+                    raise TimeoutError("no reachable primary")
+                got = self.msgr.call(
+                    self.osd_addrs[prim],
+                    {"type": "ec_write", "pool": pool_id, "ps": ps,
+                     "oid": oid, "offset": offset,
+                     "data": data.hex()}, timeout=15)
+                if got.get("ok"):
+                    return
+                if got.get("error") == "not primary" and \
+                        got.get("primary") in self.osd_addrs:
+                    got = self.msgr.call(
+                        self.osd_addrs[got["primary"]],
+                        {"type": "ec_write", "pool": pool_id,
+                         "ps": ps, "oid": oid, "offset": offset,
+                         "data": data.hex()}, timeout=15)
+                    if got.get("ok"):
+                        return
+                raise OSError(f"ec_write via osd.{prim}: {got}")
+            except (TimeoutError, OSError, KeyError):
+                if attempt + 1 == retries:
+                    raise
+                time.sleep(0.3)
+                self.refresh_map()
+
     def delete(self, pool_id: int, oid: str, retries: int = 3) -> None:
         """Tombstoned delete: peering propagates it over older writes
         (the reference's log-entry DELETE semantics)."""

@@ -88,6 +88,11 @@ class OSDService(MapFollower):
         self._recover_wake = threading.Event()
         self.backfill_throttle = Throttle(
             "backfill", ctx.conf["osd_max_backfills"])
+        # per-PG serialization: RMW coordination AND the local
+        # check-then-write path (reentrant: the RMW coordinator's
+        # self-push re-enters its own PG lock)
+        self._pg_locks: Dict[Tuple[int, int], threading.RLock] = {}
+        self._pg_locks_guard = threading.Lock()
         from ..common.op_queue import OpScheduler
         from ..common.op_tracker import OpTracker
 
@@ -107,6 +112,7 @@ class OSDService(MapFollower):
                      ("pg_scrub", self._h_pg_scrub),
                      ("shard_remove", self._h_shard_remove),
                      ("obj_delete", self._h_obj_delete),
+                     ("ec_write", self._h_ec_write),
                      ("pg_poke", self._h_pg_poke),
                      ("map_update", self._h_map_update),
                      ("map_inc", self._h_map_inc),
@@ -219,7 +225,10 @@ class OSDService(MapFollower):
         with self.optracker.create(
                 "osd_op", f"write {cid}/{oid} from "
                           f"{msg.get('frm')}") as op:
-            with self._lock:
+            # per-PG lock, not the global one: a WALStore fsync per
+            # write must never serialize the whole daemon or stall map
+            # handling behind the write stream
+            with self._pg_lock(msg["pool"], msg["ps"]):
                 # a newer version (a divergent-history reconciliation
                 # or a racing later write) must never be clobbered by
                 # an older one arriving late
@@ -275,7 +284,7 @@ class OSDService(MapFollower):
 
         cid = pg_cid(msg["pool"], msg["ps"])
         v = msg.get("v") or make_version(self.epoch)
-        with self._lock:
+        with self._pg_lock(msg["pool"], msg["ps"]):
             txn = Transaction()
             if not self.store.collection_exists(cid):
                 txn.create_collection(cid)
@@ -298,6 +307,137 @@ class OSDService(MapFollower):
                      "v": v}).encode()})
             self.store.queue_transaction(txn)
         return {"ok": True, "epoch": self.epoch}
+
+    # -- EC partial-stripe overwrite (primary-coordinated RMW) ---------
+    def _pg_lock(self, pool_id: int, ps: int) -> threading.RLock:
+        with self._pg_locks_guard:
+            return self._pg_locks.setdefault((pool_id, ps),
+                                             threading.RLock())
+
+    def _h_ec_write(self, msg: Dict) -> Dict:
+        # the RMW coordinator is control logic, NOT a store op: running
+        # it on the worker pool would deadlock (its own sub-ops submit
+        # to the same pool, and two RMWs gathering from each other's
+        # OSDs would hold every worker).  Its shard reads/writes are
+        # the scheduled, QoS-governed ops.
+        return self._do_ec_write(msg)
+
+    def _do_ec_write(self, msg: Dict) -> Dict:
+        """The ECBackend::start_rmw role (ECBackend.cc:1876-1976 +
+        ECTransaction.cc:202 overwrite): the PG PRIMARY serializes
+        partial writes under the PG lock — read the affected object
+        (any k shards, degraded reads included), merge the byte range,
+        re-encode every position at a fresh version, distribute.  The
+        per-object version total order doubles as the PG-log
+        serialization of the op."""
+        import numpy as np
+
+        pool_id, ps = int(msg["pool"]), int(msg["ps"])
+        oid = msg["oid"]
+        offset = int(msg["offset"])
+        data = bytes.fromhex(msg["data"])
+        with self._lock:
+            m = self.map
+        if m is None:
+            return {"error": "no map"}
+        pool = m.pools.get(pool_id)
+        if pool is None:
+            return {"error": f"no pool {pool_id}"}
+        up, _p, acting, _ap = m.pg_to_up_acting_osds(pool_id, ps)
+        members = acting if acting else up
+        prim = next((o for o in members if self._alive(o)), None)
+        if prim != self.id:
+            # stale client map: tell it where the primary is
+            return {"error": "not primary", "primary": prim,
+                    "epoch": self.epoch}
+        code = self._code_for(pool)
+        if code is None:
+            return {"error": "not an ec pool"}
+
+        with self._pg_lock(pool_id, ps):
+            base = self._gather_object(pool_id, ps, oid, up, code)
+            size = max(len(base), offset + len(data))
+            buf = bytearray(size)  # zero-fill holes (ObjectStore zero)
+            buf[:len(base)] = base
+            buf[offset:offset + len(data)] = data
+            v = msg.get("v") or make_version(self.epoch)
+            n = code.get_chunk_count()
+            chunks = code.encode(range(n), bytes(buf))
+            ok = True
+            for pos, osd in enumerate(up):
+                if not (osd == self.id or self._alive(osd)):
+                    ok = False  # peering recovers it at version v
+                    continue
+                self._push_shard(
+                    pool_id, ps, osd, oid, pos,
+                    np.asarray(chunks[pos], np.uint8).tobytes(),
+                    size, v, qos="client")
+            self.pc.inc("ops_w")
+            return {"ok": True, "v": v, "size": size,
+                    "degraded": not ok}
+
+    def _gather_object(self, pool_id: int, ps: int, oid: str,
+                       up: List[int], code) -> bytes:
+        """Read the full current object: any k positional shards at
+        the newest mutually-consistent version, decoded and trimmed —
+        the read-before-overwrite of ECBackend.cc:1963.  Returns b""
+        for a not-yet-existing object."""
+        import numpy as np
+
+        cid = pg_cid(pool_id, ps)
+        k = code.get_data_chunk_count()
+        got: Dict[int, Tuple[str, bytes, int]] = {}
+        for pos, osd in enumerate(up):
+            rep = self._read_shard_from(osd, pool_id, ps, oid, pos,
+                                        qos="client")
+            if rep is not None:
+                got[pos] = rep
+        if not got:
+            return b""
+        best_v = max(v for v, _d, _s in got.values())
+        chunks = {pos: np.frombuffer(d, np.uint8)
+                  for pos, (v, d, s) in got.items() if v == best_v}
+        size = next(s for v, _d, s in got.values() if v == best_v)
+        if len(chunks) < k:
+            raise OSError(f"pg {cid} {oid}: only {len(chunks)} of "
+                          f"{k} shards readable for rmw")
+        out = code.decode(set(range(k)), chunks)
+        data = np.concatenate([np.asarray(out[i], np.uint8)
+                               for i in range(k)]).tobytes()
+        return data[:size]
+
+
+    def _read_shard_from(self, osd: int, pool_id: int, ps: int,
+                         oid: str, pos: int,
+                         qos: str = "recovery"):
+        """One shard read, local store or peer RPC — the single fetch
+        primitive behind RMW gathers and both recovery paths.
+        Returns (version, data, size) or None."""
+        cid = pg_cid(pool_id, ps)
+        if osd == self.id:
+            try:
+                data = self.store.read(cid, f"{oid}.s{pos}")
+            except KeyError:
+                return None
+            v = (self.store.getattr(cid, f"{oid}.s{pos}", "v")
+                 or b"").decode()
+            size = int(self.store.getattr(cid, f"{oid}.s{pos}",
+                                          "size") or b"0")
+            return v, data, size
+        if not self._alive(osd):
+            return None
+        try:
+            got = self.msgr.call(
+                self.osd_addrs[osd],
+                {"type": "shard_read", "pool": pool_id, "ps": ps,
+                 "oid": oid, "shard": pos, "qos_class": qos},
+                timeout=5)
+        except (TimeoutError, OSError):
+            return None
+        if "data" in got:
+            return (got.get("v") or "", bytes.fromhex(got["data"]),
+                    int(got.get("size", 0)))
+        return None
 
     def _pg_local_info(self, pool_id: int, ps: int) -> Dict:
         """Fold the PG log + store into the pg_info_t this OSD reports
@@ -532,6 +672,7 @@ class OSDService(MapFollower):
                 self._set_pg_temp(pool_id, ps, acting_set)
 
         clean = True
+        ec_groups: Dict[Tuple, List[Tuple[str, Dict]]] = {}
         for oid, rec in merged.items():
             if rec["deleted"]:
                 # propagate the tombstone: anyone still holding an
@@ -543,6 +684,22 @@ class OSDService(MapFollower):
                         self._send_delete(pool_id, ps, o, oid,
                                           rec["v"])
                 continue
+            if code is not None:
+                # group EC objects by erasure pattern so each group
+                # decodes in ONE launch (the batched recovery path,
+                # ec/stripe.recover_stripes — SURVEY §2.6 row 6)
+                need = tuple(sorted(
+                    pos for pos, o in enumerate(up)
+                    if shard_v(o, oid, pos) != rec["v"]))
+                if not need:
+                    continue
+                avail = tuple(sorted(
+                    pos for pos in range(code.get_chunk_count())
+                    if any(shard_v(o, oid, pos) == rec["v"]
+                           for o in infos)))
+                ec_groups.setdefault((need, avail), []).append(
+                    (oid, rec))
+                continue
             if not self.backfill_throttle.get(timeout=5):
                 return
             try:
@@ -551,8 +708,87 @@ class OSDService(MapFollower):
                     shard_v, code)
             finally:
                 self.backfill_throttle.put()
+        for (need, avail), items in ec_groups.items():
+            if not self.backfill_throttle.get(timeout=5):
+                return
+            try:
+                clean &= self._recover_ec_batch(
+                    pool_id, ps, up, need, avail, items, infos,
+                    shard_v, code)
+            finally:
+                self.backfill_throttle.put()
         if clean:
             self._set_pg_temp(pool_id, ps, [])
+
+    def _recover_ec_batch(self, pool_id, ps, up, need, avail, items,
+                          infos, shard_v, code) -> bool:
+        """Batched EC recovery: every object in ``items`` shares one
+        erasure pattern, so their survivor chunks concatenate along
+        the byte axis and ONE decode launch reconstructs every lost
+        shard of every object (recover_stripes' execution model; the
+        codes are bytewise-linear, so decode(concat) == concat of
+        per-object decodes)."""
+        import numpy as np
+
+        cid = pg_cid(pool_id, ps)
+        k = code.get_data_chunk_count()
+        use = list(avail)[:k] if len(avail) >= k else []
+        if not use:
+            self.log.derr(f"pg {cid}: {len(items)} objects with only "
+                          f"{len(avail)} shards reachable")
+            return False
+
+        def read_pos(oid, v, pos):
+            for o in infos:
+                if shard_v(o, oid, pos) != v:
+                    continue
+                rep = self._read_shard_from(o, pool_id, ps, oid, pos)
+                if rep is not None and rep[0] == v:
+                    return np.frombuffer(rep[1], np.uint8)
+            return None
+
+        # gather per-object survivor chunks; objects with a fetch
+        # failure fall out of the batch (retried next peering pass)
+        per_obj = []
+        for oid, rec in items:
+            chunks = {}
+            for pos in use:
+                got = read_pos(oid, rec["v"], pos)
+                if got is None:
+                    break
+                chunks[pos] = got
+            if len(chunks) == len(use):
+                per_obj.append((oid, rec, chunks))
+        ok = len(per_obj) == len(items)
+        if not per_obj:
+            return False
+
+        # ONE decode launch over the concatenated byte axis
+        offsets, total = [], 0
+        for oid, rec, chunks in per_obj:
+            ln = len(next(iter(chunks.values())))
+            offsets.append((total, ln))
+            total += ln
+        surviving = {
+            pos: np.concatenate([c[pos] for _o, _r, c in per_obj])
+            for pos in use}
+        out = code.decode(set(need), surviving)
+
+        for (oid, rec, _c), (off, ln) in zip(per_obj, offsets):
+            for pos in need:
+                osd = up[pos]
+                if osd != self.id and not self._alive(osd):
+                    ok = False
+                    continue
+                shard = np.asarray(out[pos], np.uint8)[off:off + ln]
+                self._push_shard(pool_id, ps, osd, oid, pos,
+                                 shard.tobytes(), rec.get("size", 0),
+                                 rec["v"])
+            self.pc.inc("recovered_objects")
+        self.log.dout(5, f"pg {cid}: batch-recovered "
+                         f"{len(per_obj)} objects, pattern "
+                         f"need={need}")
+        return ok
 
     def _send_delete(self, pool_id, ps, osd, oid, v) -> None:
         msg = {"type": "obj_delete", "pool": pool_id, "ps": ps,
@@ -583,25 +819,9 @@ class OSDService(MapFollower):
             for o in infos:
                 if shard_v(o, oid, pos) != v:
                     continue
-                if o == self.id:
-                    try:
-                        return np.frombuffer(
-                            self.store.read(cid, f"{oid}.s{pos}"),
-                            np.uint8)
-                    except KeyError:
-                        continue
-                try:
-                    got = self.msgr.call(
-                        self.osd_addrs[o],
-                        {"type": "shard_read", "pool": pool_id,
-                         "ps": ps, "oid": oid, "shard": pos,
-                         "qos_class": "recovery"},
-                        timeout=5)
-                except (TimeoutError, OSError):
-                    continue
-                if got.get("v") == v and "data" in got:
-                    return np.frombuffer(bytes.fromhex(got["data"]),
-                                         np.uint8)
+                rep = self._read_shard_from(o, pool_id, ps, oid, pos)
+                if rep is not None and rep[0] == v:
+                    return np.frombuffer(rep[1], np.uint8)
             return None
 
         if code is None:
@@ -660,13 +880,16 @@ class OSDService(MapFollower):
         return ok
 
     def _push_shard(self, pool_id, ps, osd, oid, shard, data, size,
-                    v) -> None:
+                    v, qos: str = "recovery") -> None:
         msg = {"type": "shard_write", "pool": pool_id, "ps": ps,
                "oid": oid, "shard": shard, "data": data.hex(),
-               "size": size, "v": v, "qos_class": "recovery"}
+               "size": size, "v": v, "qos_class": qos}
         try:
             if osd == self.id:
-                self._h_shard_write(msg)
+                # direct: the caller is already a scheduled worker or
+                # the RMW coordinator — re-submitting would deadlock
+                # the worker pool
+                self._do_shard_write(msg)
             else:
                 self.msgr.call(self.osd_addrs[osd], msg, timeout=10)
         except (TimeoutError, OSError):
