@@ -56,15 +56,30 @@ _UNACKED_CAP = 512      # frames buffered per lossless peer session
 _REPLY_CACHE_CAP = 128  # replies cached per remote session
 
 
+# frames beyond this compress on the wire (a 10k-OSD full map as JSON
+# is ~MBs; zlib takes it down ~15x, which is what keeps full-map
+# fetches viable until a binary map encode replaces the JSON body)
+_COMPRESS_OVER = 16 << 10
+_ZBIT = 0x80000000  # high bit of the length word = zlib body
+
+
 def _send_frame(sock: socket.socket, msg: Dict) -> None:
+    import zlib
+
     body = json.dumps(msg).encode()
+    length = len(body)
+    if length > _COMPRESS_OVER:
+        body = zlib.compress(body, 1)
+        length = len(body) | _ZBIT
     with _send_locks_guard:
         lock = _send_locks.setdefault(id(sock), threading.Lock())
     with lock:
-        sock.sendall(struct.pack(">I", len(body)) + body)
+        sock.sendall(struct.pack(">I", length) + body)
 
 
 def _recv_frame(sock: socket.socket):
+    import zlib
+
     header = b""
     while len(header) < 4:
         got = sock.recv(4 - len(header))
@@ -72,13 +87,17 @@ def _recv_frame(sock: socket.socket):
             return None
         header += got
     (length,) = struct.unpack(">I", header)
+    packed = bool(length & _ZBIT)
+    length &= ~_ZBIT
     body = b""
     while len(body) < length:
         got = sock.recv(min(65536, length - len(body)))
         if not got:
             return None
         body += got
-    return json.loads(body.decode()), length
+    if packed:
+        body = zlib.decompress(body)
+    return json.loads(body.decode()), len(body)
 
 
 class _OutSession:

@@ -50,6 +50,10 @@ class Client(MapFollower):
                               keyring=keyring)
         self.msgr.register("map_update", self._h_map_update)
         self.msgr.register("map_inc", self._h_map_inc)
+        self.msgr.register("watch_notify", self._h_watch_notify)
+        # (pool, oid) -> callback; re-registered with the (possibly
+        # new) primary on every map change, like librados re-watch
+        self._watches: Dict[tuple, object] = {}
         self.msgr.start()
         self.map: Optional[OSDMap] = None
         self.epoch = 0
@@ -264,6 +268,79 @@ class Client(MapFollower):
                     raise
                 time.sleep(0.3)
                 self.refresh_map()
+
+    # -- watch/notify (librados rados_watch/rados_notify) --------------
+    def _primary_of(self, pool_id: int, oid: str):
+        pool, ps, up = self._up(pool_id, oid)
+        prim = next((o for o in up
+                     if o >= 0 and o in self.osd_addrs
+                     and self.map.is_up(o)), None)
+        if prim is None:
+            raise TimeoutError(f"no reachable primary for {oid}")
+        return ps, prim
+
+    def watch(self, pool_id: int, oid: str, callback) -> None:
+        """``callback(oid, payload, notifier)`` runs on every notify.
+        The registration follows the PG primary across map changes."""
+        with self._lock:
+            self._watches[(pool_id, oid)] = callback
+        self._register_watch(pool_id, oid)
+
+    def _register_watch(self, pool_id: int, oid: str) -> None:
+        ps, prim = self._primary_of(pool_id, oid)
+        self.msgr.call(self.osd_addrs[prim],
+                       {"type": "watch", "pool": pool_id, "ps": ps,
+                        "oid": oid, "watcher": self.name,
+                        "addr": list(self.msgr.addr)}, timeout=5)
+
+    def unwatch(self, pool_id: int, oid: str) -> None:
+        with self._lock:
+            self._watches.pop((pool_id, oid), None)
+        try:
+            ps, prim = self._primary_of(pool_id, oid)
+            self.msgr.call(self.osd_addrs[prim],
+                           {"type": "unwatch", "pool": pool_id,
+                            "ps": ps, "oid": oid,
+                            "watcher": self.name}, timeout=5)
+        except (TimeoutError, OSError, KeyError):
+            pass  # the primary prunes dead watchers on notify anyway
+
+    def notify(self, pool_id: int, oid: str, payload,
+               timeout: float = 5.0) -> Dict:
+        """Returns {"acks": [names], "missed": [names]}."""
+        ps, prim = self._primary_of(pool_id, oid)
+        return self.msgr.call(
+            self.osd_addrs[prim],
+            {"type": "notify", "pool": pool_id, "ps": ps,
+             "oid": oid, "payload": payload, "timeout": timeout},
+            timeout=timeout + 5.0)
+
+    def _h_watch_notify(self, msg: Dict) -> Dict:
+        with self._lock:
+            cb = self._watches.get((msg["pool"], msg["oid"]))
+        if cb is None:
+            return {"ok": False}
+        try:
+            cb(msg["oid"], msg.get("payload"), msg.get("notifier"))
+        except Exception:
+            return {"ok": False}
+        return {"ok": True}
+
+    def _post_map_install(self) -> None:
+        """Re-watch on every epoch: the primary may have moved."""
+        with self._lock:
+            watches = list(self._watches)
+        if not watches:
+            return
+
+        def rewatch():
+            for pool_id, oid in watches:
+                try:
+                    self._register_watch(pool_id, oid)
+                except (TimeoutError, OSError, KeyError):
+                    pass  # next epoch retries
+
+        threading.Thread(target=rewatch, daemon=True).start()
 
     def delete(self, pool_id: int, oid: str, retries: int = 3) -> None:
         """Tombstoned delete: peering propagates it over older writes

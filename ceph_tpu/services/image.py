@@ -40,6 +40,7 @@ class Image:
         self.pool_id = pool_id
         self.name = name
         self._h = header
+        self._parent_img: Optional["Image"] = None
         self.striper = Striper(client,
                                stripe_unit=header["stripe_unit"],
                                stripe_count=header["stripe_count"],
@@ -61,7 +62,8 @@ class Image:
             raise ImageError(f"image {name!r} exists")
         header = {"size": size, "stripe_unit": stripe_unit,
                   "stripe_count": stripe_count,
-                  "object_size": object_size, "snaps": []}
+                  "object_size": object_size, "snaps": [],
+                  "parent": None, "children": []}
         client.put(pool_id, _header_oid(name),
                    json.dumps(header).encode())
         return cls(client, pool_id, name, header)
@@ -77,6 +79,13 @@ class Image:
     def _save_header(self) -> None:
         self.client.put(self.pool_id, _header_oid(self.name),
                         json.dumps(self._h).encode())
+
+    def _reload_header(self) -> None:
+        """The header lives in RADOS; another handle (a clone's
+        flatten, a second opener) may have changed it — snapshot/clone
+        bookkeeping re-reads before deciding."""
+        raw = self.client.get(self.pool_id, _header_oid(self.name))
+        self._h = json.loads(raw.decode())
 
     # -- geometry -------------------------------------------------------
     @property
@@ -109,6 +118,11 @@ class Image:
                                 _piece_name(self.name, objectno),
                                 piece.rstrip(b"\0"))
         self._h["size"] = size
+        p = self._h.get("parent")
+        if p and size < p["overlap"]:
+            # shrink trims the COW window: a later grow reads zeros,
+            # never stale parent bytes (librbd overlap semantics)
+            p["overlap"] = size
         self._save_header()
 
     def snaps(self) -> List[str]:
@@ -129,7 +143,34 @@ class Image:
                                    _piece_name(data_name, objectno),
                                    notfound_retries=0)
         except ObjectNotFound:
+            if data_name == self.name and self._h.get("parent"):
+                return self._parent_piece(objectno)
             return b""  # sparse: unwritten pieces read as zeros
+
+    def _parent_piece(self, objectno: int) -> bytes:
+        """COW fallthrough (librbd parent overlap reads): an unwritten
+        child piece reads from the parent snapshot, trimmed to the
+        overlap window (shrink-then-grow must expose zeros, not stale
+        parent bytes)."""
+        p = self._h["parent"]
+        if self._parent_img is None:
+            self._parent_img = Image.open(self.client, p["pool"],
+                                          p["name"])
+        cache = getattr(self, "_overlap_keep", None)
+        if cache is None or cache[0] != p["overlap"]:
+            # one extent-map walk per overlap value, not per read
+            keeps: Dict[int, int] = {}
+            for objn, obj_off, _log, run in \
+                    self.striper.extent_map(0, p["overlap"]):
+                keeps[objn] = max(keeps.get(objn, 0), obj_off + run)
+            cache = (p["overlap"], keeps)
+            self._overlap_keep = cache
+        keep = cache[1].get(objectno, 0)
+        if keep == 0:
+            return b""
+        piece = self._parent_img._piece(
+            f"{p['name']}@{p['snap']}", objectno)
+        return piece[:keep]
 
     def write(self, offset: int, data: bytes) -> int:
         if offset + len(data) > self.size:
@@ -205,4 +246,74 @@ class Image:
             self.client.put(self.pool_id,
                             _piece_name(self.name, objectno), piece)
         self._h["size"] = info["size"]
+        self._save_header()
+
+    # -- clones (librbd COW clone / protect / flatten) -------------------
+    def protect_snap(self, snap: str) -> None:
+        """Clones may only hang off protected snapshots — otherwise a
+        snap removal would orphan children (librbd's protect rule)."""
+        self._reload_header()
+        self._snap(snap)["protected"] = True
+        self._save_header()
+
+    def unprotect_snap(self, snap: str) -> None:
+        self._reload_header()
+        info = self._snap(snap)
+        kids = [c for c in self._h.get("children", [])
+                if c["snap"] == snap]
+        if kids:
+            raise ImageError(
+                f"snap {snap!r} has children: "
+                f"{[c['name'] for c in kids]}")
+        info["protected"] = False
+        self._save_header()
+
+    def clone(self, snap: str, clone_name: str) -> "Image":
+        """COW clone: the child shares the parent snapshot's data and
+        copies nothing; child writes land on child pieces only, child
+        reads fall through to the parent inside the overlap window."""
+        self._reload_header()  # a sibling clone's children record
+        # must never be clobbered by a stale cached header
+        info = self._snap(snap)
+        if not info.get("protected"):
+            raise ImageError(f"snap {snap!r} is not protected")
+        child = Image.create(
+            self.client, self.pool_id, clone_name, info["size"],
+            stripe_unit=self._h["stripe_unit"],
+            stripe_count=self._h["stripe_count"],
+            object_size=self._h["object_size"])
+        child._h["parent"] = {"pool": self.pool_id,
+                              "name": self.name, "snap": snap,
+                              "overlap": info["size"]}
+        child._save_header()
+        self._h.setdefault("children", []).append(
+            {"name": clone_name, "snap": snap})
+        self._save_header()
+        return child
+
+    def flatten(self) -> None:
+        """Copy every parent-backed extent into the child and detach —
+        after this the parent snapshot can be unprotected."""
+        p = self._h.get("parent")
+        if not p:
+            return
+        for objectno in self._pieces_in_use(
+                min(self.size, p["overlap"]) or self.size):
+            try:
+                self.client.get(
+                    self.pool_id, _piece_name(self.name, objectno),
+                    notfound_retries=0)
+            except ObjectNotFound:
+                piece = self._parent_piece(objectno)
+                if piece:
+                    self.client.put(
+                        self.pool_id,
+                        _piece_name(self.name, objectno), piece)
+        parent = Image.open(self.client, p["pool"], p["name"])
+        parent._h["children"] = [
+            c for c in parent._h.get("children", [])
+            if not (c["name"] == self.name and c["snap"] == p["snap"])]
+        parent._save_header()
+        self._h["parent"] = None
+        self._parent_img = None
         self._save_header()

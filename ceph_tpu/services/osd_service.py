@@ -97,6 +97,10 @@ class OSDService(MapFollower):
         from ..common.op_tracker import OpTracker
 
         self.optracker = OpTracker()
+        # (cid, oid) -> {watcher name: addr}: the Watch/Notify state
+        # (src/osd/Watch.cc role).  In-memory: clients re-watch on map
+        # changes, exactly like librados re-watches on reconnect.
+        self._watchers: Dict[Tuple[str, str], Dict[str, Addr]] = {}
         # dmClock QoS at the store door: client vs recovery vs scrub
         # ops are served in tag order by a small worker pool
         self.sched = OpScheduler(n_workers=2)
@@ -113,6 +117,9 @@ class OSDService(MapFollower):
                      ("shard_remove", self._h_shard_remove),
                      ("obj_delete", self._h_obj_delete),
                      ("ec_write", self._h_ec_write),
+                     ("watch", self._h_watch),
+                     ("unwatch", self._h_unwatch),
+                     ("notify", self._h_notify),
                      ("pg_poke", self._h_pg_poke),
                      ("map_update", self._h_map_update),
                      ("map_inc", self._h_map_inc),
@@ -362,19 +369,26 @@ class OSDService(MapFollower):
             buf[offset:offset + len(data)] = data
             v = msg.get("v") or make_version(self.epoch)
             n = code.get_chunk_count()
+            k = code.get_data_chunk_count()
             chunks = code.encode(range(n), bytes(buf))
-            ok = True
+            landed = 0
             for pos, osd in enumerate(up):
                 if not (osd == self.id or self._alive(osd)):
-                    ok = False  # peering recovers it at version v
-                    continue
-                self._push_shard(
-                    pool_id, ps, osd, oid, pos,
-                    np.asarray(chunks[pos], np.uint8).tobytes(),
-                    size, v, qos="client")
+                    continue  # peering recovers it at version v
+                if self._push_shard(
+                        pool_id, ps, osd, oid, pos,
+                        np.asarray(chunks[pos], np.uint8).tobytes(),
+                        size, v, qos="client"):
+                    landed += 1
+            if landed < k:
+                # an acked write must be durable: fewer than k shards
+                # at v would be acknowledged-but-unreadable data loss
+                # (the peers may be hung yet still map-up)
+                return {"error": f"only {landed} of {k} required "
+                                 f"shards persisted"}
             self.pc.inc("ops_w")
             return {"ok": True, "v": v, "size": size,
-                    "degraded": not ok}
+                    "degraded": landed < n}
 
     def _gather_object(self, pool_id: int, ps: int, oid: str,
                        up: List[int], code) -> bytes:
@@ -496,6 +510,53 @@ class OSDService(MapFollower):
         """A peer lost a shard (scrub repair) or wants re-peering."""
         self._recover_wake.set()
         return None
+
+    # -- watch/notify (librados watch/notify, src/osd/Watch.cc) --------
+    def _h_watch(self, msg: Dict) -> Dict:
+        key = (pg_cid(msg["pool"], msg["ps"]), msg["oid"])
+        with self._lock:
+            ws = self._watchers.setdefault(key, {})
+            ws[msg["watcher"]] = tuple(msg["addr"])
+            count = len(ws)  # under the lock: a racing unwatch may
+            # pop the key before we return
+        return {"ok": True, "watchers": count}
+
+    def _h_unwatch(self, msg: Dict) -> Dict:
+        key = (pg_cid(msg["pool"], msg["ps"]), msg["oid"])
+        with self._lock:
+            ws = self._watchers.get(key, {})
+            ws.pop(msg["watcher"], None)
+            if not ws:
+                self._watchers.pop(key, None)
+        return {"ok": True}
+
+    def _h_notify(self, msg: Dict) -> Dict:
+        """Fan the notify out to every watcher and collect acks within
+        the timeout — the rados_notify round-trip contract."""
+        key = (pg_cid(msg["pool"], msg["ps"]), msg["oid"])
+        with self._lock:
+            watchers = dict(self._watchers.get(key, {}))
+        acks, missed = [], []
+        note = {"type": "watch_notify", "pool": msg["pool"],
+                "ps": msg["ps"], "oid": msg["oid"],
+                "payload": msg.get("payload"),
+                "notifier": msg.get("frm")}
+        deadline = time.monotonic() + float(msg.get("timeout", 5.0))
+        for name, addr in watchers.items():
+            left = max(0.2, deadline - time.monotonic())
+            try:
+                rep = self.msgr.call(addr, dict(note),
+                                     timeout=min(5.0, left))
+                (acks if rep.get("ok") else missed).append(name)
+            except TimeoutError:
+                missed.append(name)  # slow != gone: keep the watch
+            except OSError:
+                missed.append(name)
+                # connection refused = the watcher is gone; a pruned
+                # live client re-watches on the next map epoch
+                with self._lock:
+                    self._watchers.get(key, {}).pop(name, None)
+        return {"ok": True, "acks": acks, "missed": missed}
 
     def _h_pg_list(self, msg: Dict) -> Dict:
         cid = pg_cid(msg["pool"], msg["ps"])
@@ -880,7 +941,7 @@ class OSDService(MapFollower):
         return ok
 
     def _push_shard(self, pool_id, ps, osd, oid, shard, data, size,
-                    v, qos: str = "recovery") -> None:
+                    v, qos: str = "recovery") -> bool:
         msg = {"type": "shard_write", "pool": pool_id, "ps": ps,
                "oid": oid, "shard": shard, "data": data.hex(),
                "size": size, "v": v, "qos_class": qos}
@@ -891,9 +952,12 @@ class OSDService(MapFollower):
                 # the worker pool
                 self._do_shard_write(msg)
             else:
-                self.msgr.call(self.osd_addrs[osd], msg, timeout=10)
+                rep = self.msgr.call(self.osd_addrs[osd], msg,
+                                     timeout=10)
+                return bool(rep.get("ok"))
+            return True
         except (TimeoutError, OSError):
-            pass
+            return False
 
     def _set_pg_temp(self, pool_id: int, ps: int,
                      osds: List[int]) -> None:

@@ -330,3 +330,117 @@ def test_ec_degraded_overwrite(cluster):
     cluster.wait_for_up(victim, timeout=10)
     cluster.wait_for_recovery(2, {"deg-obj": None}, timeout=30)
     assert c.get(2, "deg-obj") == bytes(want)
+
+
+def test_watch_notify(cluster):
+    """librados watch/notify: a watcher gets every notify with its
+    payload and the notifier collects acks; registration follows the
+    PG primary across map changes (re-watch on epoch)."""
+    import threading
+    import time as _time
+
+    watcher = cluster.client("watcher")
+    notifier = cluster.client("notifier")
+    got = []
+    ev = threading.Event()
+
+    def cb(oid, payload, notifier_name):
+        got.append((oid, payload, notifier_name))
+        ev.set()
+
+    watcher.put(1, "watched", b"state-0")
+    watcher.watch(1, "watched", cb)
+    rep = notifier.notify(1, "watched", {"event": "flush", "n": 1})
+    assert "client.watcher" in rep["acks"] or \
+        "watcher" in str(rep["acks"])
+    assert ev.wait(timeout=5)
+    assert got[0][0] == "watched" and got[0][1]["event"] == "flush"
+
+    # unwatch: no further delivery, notifier sees zero acks
+    watcher.unwatch(1, "watched")
+    ev.clear()
+    rep = notifier.notify(1, "watched", {"event": "x"})
+    assert rep["acks"] == []
+    assert not ev.wait(timeout=1.0)
+
+
+def test_watch_survives_primary_move(cluster):
+    """Kill the PG primary: after remap + re-watch, notifies reach the
+    watcher through the new primary."""
+    import threading
+
+    watcher = cluster.client("watcher2")
+    notifier = cluster.client("notifier2")
+    ev = threading.Event()
+    watcher.put(1, "roaming", b"x")
+    watcher.watch(1, "roaming", lambda *a: ev.set())
+
+    _pool, _ps, up = watcher._up(1, "roaming")
+    victim = up[0]
+    cluster.kill_osd(victim)
+    cluster.wait_for_down(victim, timeout=10)
+
+    import time as _time
+
+    deadline = _time.monotonic() + 15
+    while _time.monotonic() < deadline:
+        notifier.refresh_map()
+        watcher.refresh_map()
+        try:
+            rep = notifier.notify(1, "roaming", {"ping": 1})
+            if rep.get("acks"):
+                break
+        except Exception:
+            pass
+        _time.sleep(0.5)
+    assert ev.wait(timeout=5), "notify never reached the watcher " \
+        "after primary failover"
+    cluster.revive_osd(victim)
+    cluster.wait_for_up(victim, timeout=10)
+
+
+def test_image_clone_cow_and_flatten(cluster):
+    """librbd clone semantics: protect -> clone (no data copied) ->
+    child reads fall through to the parent snap, child writes COW,
+    flatten detaches, unprotect guarded by children."""
+    import pytest as _pytest
+
+    from ceph_tpu.services.image import Image, ImageError
+
+    cli = cluster.client("rbd-clone")
+    img = Image.create(cli, 1, "parent-img", 64 * 1024,
+                       object_size=16 * 1024)
+    img.write(0, b"P" * 1000)
+    img.write(30_000, b"Q" * 500)
+    img.snapshot("s1")
+    with _pytest.raises(ImageError):
+        img.clone("s1", "child-unprotected")
+    img.protect_snap("s1")
+    child = img.clone("s1", "child-img")
+
+    # child sees parent data without copies, parent changes don't leak
+    assert child.read(0, 1000) == b"P" * 1000
+    img.write(0, b"X" * 1000)  # post-snap parent write
+    assert child.read(0, 1000) == b"P" * 1000
+    # COW: child write covers only its range; rest still parent's
+    child.write(100, b"c" * 50)
+    got = child.read(0, 1000)
+    assert got[:100] == b"P" * 100 and got[100:150] == b"c" * 50 \
+        and got[150:] == b"P" * 850
+    assert child.read(30_000, 500) == b"Q" * 500
+
+    # unprotect refused while the child exists; flatten releases it
+    with _pytest.raises(ImageError):
+        img.unprotect_snap("s1")
+    child.flatten()
+    assert child.read(0, 100) == b"P" * 100
+    assert child.read(30_000, 500) == b"Q" * 500
+    img.unprotect_snap("s1")
+
+    # shrink-then-grow exposes zeros, never stale parent bytes
+    child2 = None
+    img.protect_snap("s1")
+    child2 = img.clone("s1", "child2-img")
+    child2.resize(1024)
+    child2.resize(40_000)
+    assert child2.read(30_000, 500) == bytes(500)

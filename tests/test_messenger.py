@@ -171,3 +171,23 @@ def test_per_type_byte_throttle_bounds_inflight():
     finally:
         client.shutdown()
         server.shutdown()
+
+
+def test_large_frames_compress_on_the_wire():
+    """Full-map-sized frames ride zlib-compressed (high bit of the
+    length word), transparently to both sides."""
+    server, client = mk_pair(lossless=False)
+    server.register("blob", lambda m: {"echo_len": len(m["d"]),
+                                       "d": m["d"][:8]})
+    try:
+        big = "A" * 300_000  # compressible, like a JSON map
+        rep = client.call(server.addr, {"type": "blob", "d": big},
+                          timeout=15)
+        assert rep["echo_len"] == 300_000 and rep["d"] == "A" * 8
+        # and the reply path with a big payload
+        server.register("pull", lambda m: {"d": big})
+        rep = client.call(server.addr, {"type": "pull"}, timeout=15)
+        assert rep["d"] == big
+    finally:
+        client.shutdown()
+        server.shutdown()
