@@ -18,6 +18,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..common.version import make_version
 from ..msg.messenger import Addr, Messenger
 from ..osdmap.osdmap import OSDMap, POOL_TYPE_ERASURE
 from ..ec.registry import profile_factory
@@ -97,35 +98,39 @@ class Client(MapFollower):
     # -- data path -------------------------------------------------------
     def put(self, pool_id: int, oid: str, data: bytes,
             retries: int = 3) -> None:
-        from ..common.version import make_version
-
         # one version for every shard of this logical write: replicas
         # agree on recency at peering time (the eversion_t role)
         v = make_version(self.epoch)
         for attempt in range(retries):
-            pool, ps, up = self._up(pool_id, oid)
-            code = self._code_for(pool)
             try:
+                # inside the retry loop: a freshly-created pool may be
+                # a map epoch away (a peon served the refresh before
+                # applying the commit) — KeyError retries like any
+                # stale-map condition
+                pool, ps, up = self._up(pool_id, oid)
+                code = self._code_for(pool)
                 if code is None:
                     for pos, osd in enumerate(up):
                         self._write_shard(pool_id, ps, oid, osd, 0,
                                           data, len(data), v)
                 else:
-                    n = code.get_chunk_count()
-                    chunks = code.encode(range(n), data)
-                    # EC up sets are positional: down OSDs appear as
-                    # NONE holes, not a shorter list — any unreachable
-                    # position means the write must wait for remap
-                    if len(up) < n or any(
-                            o < 0 or o not in self.osd_addrs
-                            for o in up[:n]):
-                        raise TimeoutError("degraded up set for write")
-                    for pos in range(n):
-                        self._write_shard(
-                            pool_id, ps, oid, up[pos], pos,
-                            np.asarray(chunks[pos],
-                                       np.uint8).tobytes(),
-                            len(data), v)
+                    # EC writes route through the PG primary, which
+                    # encodes and distributes under the PG lock — the
+                    # only way a write can serialize against peering's
+                    # divergent-shard rollback (the reference sends
+                    # every op to the primary for the same reason)
+                    prim = self._first_reachable(up)
+                    if prim is None:
+                        raise TimeoutError("no reachable primary")
+                    got = self.msgr.call(
+                        self.osd_addrs[prim],
+                        {"type": "ec_write", "pool": pool_id,
+                         "ps": ps, "oid": oid, "offset": 0,
+                         "data": data.hex(), "v": v, "full": True},
+                        timeout=20)
+                    if not got.get("ok"):
+                        raise OSError(
+                            f"ec put via osd.{prim}: {got}")
                 return
             except (TimeoutError, OSError, KeyError):
                 if attempt + 1 == retries:
@@ -156,9 +161,9 @@ class Client(MapFollower):
         # retry must never convert into OSError('unreachable') when the
         # miss is definitive — callers branch on ObjectNotFound
         while True:
-            pool, ps, up = self._up(pool_id, oid)
-            code = self._code_for(pool)
             try:
+                pool, ps, up = self._up(pool_id, oid)
+                code = self._code_for(pool)
                 if code is None:
                     return self._read_replicated(pool_id, ps, oid, up)
                 return self._read_ec(pool_id, ps, oid, up, code)
@@ -223,9 +228,9 @@ class Client(MapFollower):
         put (last-writer-wins at object granularity, like the
         reference's replicated offset write under a single client)."""
         for attempt in range(retries):
-            pool, ps, up = self._up(pool_id, oid)
-            code = self._code_for(pool)
             try:
+                pool, ps, up = self._up(pool_id, oid)
+                code = self._code_for(pool)
                 if code is None:
                     try:
                         base = self.get(pool_id, oid,
@@ -241,16 +246,15 @@ class Client(MapFollower):
                 # same liveness rule as the server's primary check:
                 # first UP member, else the op targets a dead daemon
                 # the real primary would skip
-                prim = next((o for o in up
-                             if o >= 0 and o in self.osd_addrs
-                             and self.map.is_up(o)), None)
+                prim = self._first_reachable(up)
                 if prim is None:
                     raise TimeoutError("no reachable primary")
+                v = make_version(self.epoch)
                 got = self.msgr.call(
                     self.osd_addrs[prim],
                     {"type": "ec_write", "pool": pool_id, "ps": ps,
                      "oid": oid, "offset": offset,
-                     "data": data.hex()}, timeout=15)
+                     "data": data.hex(), "v": v}, timeout=15)
                 if got.get("ok"):
                     return
                 if got.get("error") == "not primary" and \
@@ -259,7 +263,7 @@ class Client(MapFollower):
                         self.osd_addrs[got["primary"]],
                         {"type": "ec_write", "pool": pool_id,
                          "ps": ps, "oid": oid, "offset": offset,
-                         "data": data.hex()}, timeout=15)
+                         "data": data.hex(), "v": v}, timeout=15)
                     if got.get("ok"):
                         return
                 raise OSError(f"ec_write via osd.{prim}: {got}")
@@ -269,12 +273,17 @@ class Client(MapFollower):
                 time.sleep(0.3)
                 self.refresh_map()
 
+    def _first_reachable(self, up):
+        """The routing invariant: first up, addressable, non-NONE
+        member — the op target every primary-coordinated path uses."""
+        return next((o for o in up
+                     if o >= 0 and o in self.osd_addrs
+                     and self.map.is_up(o)), None)
+
     # -- watch/notify (librados rados_watch/rados_notify) --------------
     def _primary_of(self, pool_id: int, oid: str):
         pool, ps, up = self._up(pool_id, oid)
-        prim = next((o for o in up
-                     if o >= 0 and o in self.osd_addrs
-                     and self.map.is_up(o)), None)
+        prim = self._first_reachable(up)
         if prim is None:
             raise TimeoutError(f"no reachable primary for {oid}")
         return ps, prim
@@ -345,12 +354,10 @@ class Client(MapFollower):
     def delete(self, pool_id: int, oid: str, retries: int = 3) -> None:
         """Tombstoned delete: peering propagates it over older writes
         (the reference's log-entry DELETE semantics)."""
-        from ..common.version import make_version
-
         v = make_version(self.epoch)
         for attempt in range(retries):
-            pool, ps, up = self._up(pool_id, oid)
             try:
+                pool, ps, up = self._up(pool_id, oid)
                 for osd in {o for o in up
                             if o >= 0 and o in self.osd_addrs}:
                     got = self.msgr.call(
@@ -369,16 +376,23 @@ class Client(MapFollower):
 
     def _read_ec(self, pool_id, ps, oid, up, code) -> bytes:
         """Gather any k shards (degraded reads ride the same path the
-        reference's objects_read_and_reconstruct does)."""
+        reference's objects_read_and_reconstruct does).
+
+        Chunks from different writes never decode together, so shards
+        group by version and the NEWEST version with >= k chunks wins:
+        a torn higher-version write (partially landed, never acked —
+        peering will roll it back) must not shadow the last acked
+        state."""
         k = code.get_data_chunk_count()
-        chunks: Dict[int, np.ndarray] = {}
-        vers: Dict[int, str] = {}
-        size = None
+        by_ver: Dict[str, Dict[int, np.ndarray]] = {}
+        sizes: Dict[str, int] = {}
         enoent = 0
         reachable = 0
         for pos, osd in enumerate(up):
-            if len(chunks) >= k:
-                break
+            done = any(len(c) >= k for c in by_ver.values())
+            if done and max(by_ver) == max(
+                    (v for v, c in by_ver.items() if len(c) >= k)):
+                break  # the newest version seen is already decodable
             try:
                 got = self.msgr.call(
                     self.osd_addrs[osd],
@@ -389,24 +403,17 @@ class Client(MapFollower):
             reachable += 1
             if "data" in got:
                 v = got.get("v") or ""
-                if vers and v != max(vers.values()):
-                    # mixed versions mid-reconciliation: chunks from
-                    # different writes never decode together — keep
-                    # only the newest write's shards
-                    if any(v > hv for hv in vers.values()):
-                        chunks.clear()
-                        vers.clear()
-                    else:
-                        continue  # stale shard: unusable for decode
-                chunks[pos] = np.frombuffer(
+                by_ver.setdefault(v, {})[pos] = np.frombuffer(
                     bytes.fromhex(got["data"]), np.uint8)
-                vers[pos] = v
-                size = got["size"]
+                sizes[v] = got["size"]
             elif got.get("error") == "enoent":
                 enoent += 1
-        if len(chunks) < k or size is None:
+        decodable = [v for v, c in by_ver.items() if len(c) >= k]
+        if not decodable:
             if reachable and enoent == reachable:
                 raise ObjectNotFound(oid)
+            have = max((len(c) for c in by_ver.values()), default=0)
             raise TimeoutError(
-                f"only {len(chunks)}/{k} shards reachable for {oid}")
-        return code.decode_concat(chunks)[:size]
+                f"only {have}/{k} shards reachable for {oid}")
+        best = max(decodable)
+        return code.decode_concat(by_ver[best])[:sizes[best]]

@@ -101,6 +101,11 @@ class OSDService(MapFollower):
         # (src/osd/Watch.cc role).  In-memory: clients re-watch on map
         # changes, exactly like librados re-watches on reconnect.
         self._watchers: Dict[Tuple[str, str], Dict[str, Addr]] = {}
+        # (pool, ps) -> stray holders that reported data for a PG this
+        # osd is primary of (the MOSDPGNotify stray flow): peering
+        # queries them so shards that remapped AWAY from the up set
+        # stay reachable, and purges them once the PG is clean
+        self._strays: Dict[Tuple[int, int], Set[int]] = {}
         # dmClock QoS at the store door: client vs recovery vs scrub
         # ops are served in tag order by a small worker pool
         self.sched = OpScheduler(n_workers=2)
@@ -121,6 +126,8 @@ class OSDService(MapFollower):
                      ("unwatch", self._h_unwatch),
                      ("notify", self._h_notify),
                      ("pg_poke", self._h_pg_poke),
+                     ("pg_stray", self._h_pg_stray),
+                     ("pg_purge", self._h_pg_purge),
                      ("map_update", self._h_map_update),
                      ("map_inc", self._h_map_inc),
                      ("status", self._h_status)):
@@ -242,8 +249,13 @@ class OSDService(MapFollower):
                 cur = self.store.getattr(cid, oid, "v") \
                     if self.store.collection_exists(cid) else None
                 if cur is not None and cur.decode() > v:
-                    return {"ok": True, "superseded": True,
-                            "epoch": self.epoch}
+                    if not msg.get("force") or (
+                            msg.get("expect") is not None
+                            and cur.decode() != msg["expect"]):
+                        return {"ok": True, "superseded": True,
+                                "epoch": self.epoch}
+                    # authoritative rollback of a torn (never-acked)
+                    # higher-version shard: fall through and overwrite
                 txn = Transaction()
                 if not self.store.collection_exists(cid):
                     txn.create_collection(cid)
@@ -362,11 +374,16 @@ class OSDService(MapFollower):
             return {"error": "not an ec pool"}
 
         with self._pg_lock(pool_id, ps):
-            base = self._gather_object(pool_id, ps, oid, up, code)
-            size = max(len(base), offset + len(data))
-            buf = bytearray(size)  # zero-fill holes (ObjectStore zero)
-            buf[:len(base)] = base
-            buf[offset:offset + len(data)] = data
+            if msg.get("full"):
+                # whole-object write: replaces content, no read-merge
+                buf = bytearray(data)
+                size = len(buf)
+            else:
+                base = self._gather_object(pool_id, ps, oid, up, code)
+                size = max(len(base), offset + len(data))
+                buf = bytearray(size)  # zero-fill holes
+                buf[:len(base)] = base
+                buf[offset:offset + len(data)] = data
             v = msg.get("v") or make_version(self.epoch)
             n = code.get_chunk_count()
             k = code.get_data_chunk_count()
@@ -500,8 +517,8 @@ class OSDService(MapFollower):
                     objects[oid] = {"v": vpos, "deleted": False,
                                     "size": int(size), "shards": {}}
                 objects[oid]["shards"][pos] = vpos
-        return {"osd": self.id, "last_update": last_update,
-                "objects": objects}
+        return {"osd": self.id, "epoch": self.epoch,
+                "last_update": last_update, "objects": objects}
 
     def _h_pg_info(self, msg: Dict) -> Dict:
         return self._pg_local_info(int(msg["pool"]), int(msg["ps"]))
@@ -510,6 +527,52 @@ class OSDService(MapFollower):
         """A peer lost a shard (scrub repair) or wants re-peering."""
         self._recover_wake.set()
         return None
+
+    # -- stray PGs (MOSDPGNotify role) ---------------------------------
+    def _h_pg_stray(self, msg: Dict) -> None:
+        """A former member still holds this PG's data: include it in
+        peering so remapped-away shards stay reachable."""
+        key = (int(msg["pool"]), int(msg["ps"]))
+        with self._lock:
+            self._strays.setdefault(key, set()).add(int(msg["osd"]))
+        self._recover_wake.set()
+        return None
+
+    def _h_pg_purge(self, msg: Dict) -> Dict:
+        """The primary declared the PG clean: this stray's copy is no
+        longer needed (PG removal)."""
+        cid = pg_cid(msg["pool"], msg["ps"])
+        with self._lock:
+            m = self.map
+        if m is not None:
+            up, _p, acting, _ap = m.pg_to_up_acting_osds(
+                int(msg["pool"]), int(msg["ps"]))
+            if self.id in up or self.id in acting:
+                return {"ok": False, "error": "still a member"}
+        if self.store.collection_exists(cid):
+            self.store.queue_transaction(
+                Transaction().remove_collection(cid))
+        return {"ok": True}
+
+    def _report_strays(self, m) -> None:
+        """Per epoch: any local PG collection this osd no longer
+        serves gets announced to the PG's current primary."""
+        for cid in self.store.list_collections():
+            try:
+                pool_s, ps_s = cid.split(".", 1)
+                pool_id, ps = int(pool_s), int(ps_s)
+            except ValueError:
+                continue
+            if pool_id not in m.pools:
+                continue
+            up, _p, acting, _ap = m.pg_to_up_acting_osds(pool_id, ps)
+            if self.id in up or self.id in acting:
+                continue
+            prim = next((o for o in up if self._alive(o)), None)
+            if prim is not None and prim != self.id:
+                self.msgr.send(self.osd_addrs[prim],
+                               {"type": "pg_stray", "pool": pool_id,
+                                "ps": ps, "osd": self.id})
 
     # -- watch/notify (librados watch/notify, src/osd/Watch.cc) --------
     def _h_watch(self, msg: Dict) -> Dict:
@@ -660,6 +723,7 @@ class OSDService(MapFollower):
             m = self.map
         if m is None:
             return
+        self._report_strays(m)
         for pool_id, pool in m.pools.items():
             for ps in range(pool.pg_num):
                 up, _p, acting, _ap = m.pg_to_up_acting_osds(pool_id,
@@ -673,13 +737,30 @@ class OSDService(MapFollower):
     def _peer_pg(self, m, pool_id: int, pool, ps: int,
                  up: List[int], acting: List[int]) -> None:
         """Collect infos, merge to the authoritative per-object state,
-        drive pulls/pushes/deletes, manage the pg_temp overlay."""
+        drive pulls/pushes/deletes, manage the pg_temp overlay.
+
+        Holds the PG lock for the whole pass: client EC ops route
+        through the primary and take the same lock, so peering's
+        rollback decisions can never interleave with a half-landed
+        write (the reference gates ops on peering state the same
+        way).  Cross-daemon shard pushes take only the REMOTE pg
+        lock transiently — per-(osd, pg) locks cannot cycle because a
+        PG has one primary."""
+        with self._pg_lock(pool_id, ps):
+            self._peer_pg_locked(m, pool_id, pool, ps, up, acting)
+
+    def _peer_pg_locked(self, m, pool_id: int, pool, ps: int,
+                        up: List[int], acting: List[int]) -> None:
         cid = pg_cid(pool_id, ps)
         code = self._code_for(pool)
-        # query every reachable member of up AND acting (the acting set
-        # holds the data during a backfill interval — the past-interval
-        # members that matter at this harness's scale)
-        members = sorted({o for o in (list(up) + list(acting))
+        # query every reachable member of up and acting PLUS reported
+        # strays (former members still holding data after a remap —
+        # the past-intervals/MOSDPGNotify role): without them, shards
+        # that remapped away from the up set would be unreachable
+        with self._lock:
+            strays = set(self._strays.get((pool_id, ps), set()))
+        members = sorted({o for o in (list(up) + list(acting)
+                                      + list(strays))
                           if o == self.id or self._alive(o)})
         infos: Dict[int, Dict] = {}
         for o in members:
@@ -693,6 +774,12 @@ class OSDService(MapFollower):
                     timeout=5)
             except (TimeoutError, OSError):
                 continue
+            if int(infos[o].get("epoch", 0)) > self.epoch:
+                # a member runs a newer map: this primary may already
+                # be deposed — abort; the map install re-wakes peering
+                # (shrinks the dual-primary window during transitions)
+                self._recover_wake.set()
+                return
         # merge: newest version wins per object (delete tombstones
         # included) — the result of authoritative-log election + merge
         merged: Dict[str, Dict] = {}
@@ -735,6 +822,56 @@ class OSDService(MapFollower):
         clean = True
         ec_groups: Dict[Tuple, List[Tuple[str, Dict]]] = {}
         for oid, rec in merged.items():
+            if code is not None:
+                # EC: the authoritative version is the newest
+                # RECOVERABLE one — >= k positions hold it somewhere.
+                # A torn partial write (higher version, < k shards —
+                # never acked) is ROLLED BACK, the reference's
+                # divergent-entry rollback (PGLog::rewind_divergent).
+                k = code.get_data_chunk_count()
+                cover: Dict[str, Set[int]] = {}
+                tombs: List[str] = []
+                for o, info in infos.items():
+                    orec = info.get("objects", {}).get(oid)
+                    if not orec:
+                        continue
+                    if orec.get("deleted"):
+                        tombs.append(orec["v"])
+                    for pos_s, pv in orec.get("shards", {}).items():
+                        if pv != NULL_VERSION:
+                            cover.setdefault(pv, set()).add(
+                                int(pos_s))
+                best_write = max(
+                    (v for v, poss in cover.items()
+                     if len(poss) >= k), default=None)
+                best_tomb = max(tombs, default=None)
+                if best_tomb is not None and (
+                        best_write is None or best_tomb > best_write):
+                    for o, info in infos.items():
+                        lrec = info.get("objects", {}).get(oid)
+                        if lrec and not lrec.get("deleted") \
+                                and lrec["v"] < best_tomb:
+                            self._send_delete(pool_id, ps, o, oid,
+                                              best_tomb)
+                    continue
+                if best_write is None:
+                    if cover:
+                        clean = False
+                        self.log.derr(
+                            f"pg {cid} {oid}: no recoverable "
+                            f"version (coverage "
+                            f"{ {v: len(p) for v, p in cover.items()} })")
+                    continue
+                need = tuple(sorted(
+                    pos for pos, o in enumerate(up)
+                    if shard_v(o, oid, pos) != best_write))
+                if not need:
+                    continue
+                avail = tuple(sorted(cover[best_write]))
+                rec = dict(rec, v=best_write)
+                ec_groups.setdefault((need, avail, best_write),
+                                     []).append((oid, rec))
+                continue
             if rec["deleted"]:
                 # propagate the tombstone: anyone still holding an
                 # older live version drops it
@@ -745,22 +882,6 @@ class OSDService(MapFollower):
                         self._send_delete(pool_id, ps, o, oid,
                                           rec["v"])
                 continue
-            if code is not None:
-                # group EC objects by erasure pattern so each group
-                # decodes in ONE launch (the batched recovery path,
-                # ec/stripe.recover_stripes — SURVEY §2.6 row 6)
-                need = tuple(sorted(
-                    pos for pos, o in enumerate(up)
-                    if shard_v(o, oid, pos) != rec["v"]))
-                if not need:
-                    continue
-                avail = tuple(sorted(
-                    pos for pos in range(code.get_chunk_count())
-                    if any(shard_v(o, oid, pos) == rec["v"]
-                           for o in infos)))
-                ec_groups.setdefault((need, avail), []).append(
-                    (oid, rec))
-                continue
             if not self.backfill_throttle.get(timeout=5):
                 return
             try:
@@ -769,7 +890,7 @@ class OSDService(MapFollower):
                     shard_v, code)
             finally:
                 self.backfill_throttle.put()
-        for (need, avail), items in ec_groups.items():
+        for (need, avail, _v), items in ec_groups.items():
             if not self.backfill_throttle.get(timeout=5):
                 return
             try:
@@ -780,6 +901,22 @@ class OSDService(MapFollower):
                 self.backfill_throttle.put()
         if clean:
             self._set_pg_temp(pool_id, ps, [])
+            # every up member holds everything: strays may drop their
+            # copies (PG removal after clean)
+            for o in strays:
+                if o in up or o in acting or not self._alive(o):
+                    continue
+                try:
+                    rep = self.msgr.call(
+                        self.osd_addrs[o],
+                        {"type": "pg_purge", "pool": pool_id,
+                         "ps": ps}, timeout=5)
+                    if rep.get("ok"):
+                        with self._lock:
+                            self._strays.get((pool_id, ps),
+                                             set()).discard(o)
+                except (TimeoutError, OSError):
+                    pass
 
     def _recover_ec_batch(self, pool_id, ps, up, need, avail, items,
                           infos, shard_v, code) -> bool:
@@ -805,7 +942,7 @@ class OSDService(MapFollower):
                     continue
                 rep = self._read_shard_from(o, pool_id, ps, oid, pos)
                 if rep is not None and rep[0] == v:
-                    return np.frombuffer(rep[1], np.uint8)
+                    return np.frombuffer(rep[1], np.uint8), rep[2]
             return None
 
         # gather per-object survivor chunks; objects with a fetch
@@ -817,7 +954,10 @@ class OSDService(MapFollower):
                 got = read_pos(oid, rec["v"], pos)
                 if got is None:
                     break
-                chunks[pos] = got
+                chunks[pos] = got[0]
+                # the object size travels with the shard: the info
+                # record's size may describe a newer torn version
+                rec["size"] = got[1]
             if len(chunks) == len(use):
                 per_obj.append((oid, rec, chunks))
         ok = len(per_obj) == len(items)
@@ -842,9 +982,14 @@ class OSDService(MapFollower):
                     ok = False
                     continue
                 shard = np.asarray(out[pos], np.uint8)[off:off + ln]
+                # force+expect: the authoritative version may be LOWER
+                # than a torn never-acked shard on this member — roll
+                # it back, but only if the shard is still exactly what
+                # peering observed (a racing newer client write wins)
                 self._push_shard(pool_id, ps, osd, oid, pos,
                                  shard.tobytes(), rec.get("size", 0),
-                                 rec["v"])
+                                 rec["v"], force=True,
+                                 expect=shard_v(osd, oid, pos))
             self.pc.inc("recovered_objects")
         self.log.dout(5, f"pg {cid}: batch-recovered "
                          f"{len(per_obj)} objects, pattern "
@@ -864,87 +1009,50 @@ class OSDService(MapFollower):
 
     def _recover_object(self, m, pool_id, pool, ps, up, oid, rec,
                         infos, shard_v, code) -> bool:
-        """Primary-driven object recovery at the authoritative version
-        (ECBackend::recover_object / ReplicatedBackend push-pull):
-        returns True when every up member holds its shard of oid@v.
-        Everything is keyed by shard POSITION — a member that moved
-        positions in a remap still serves the old position's shard as
-        a pull source while needing its new one."""
+        """Primary-driven REPLICATED object recovery at the
+        authoritative version (ReplicatedBackend push-pull): returns
+        True when every up member holds oid@v.  EC objects never reach
+        here — _peer_pg_locked routes them through the torn-write-aware
+        batched path (_recover_ec_batch)."""
         import numpy as np
 
+        assert code is None, "EC recovery goes through the batch path"
         cid = pg_cid(pool_id, ps)
         v, size = rec["v"], rec.get("size", 0)
-
-        def read_pos(pos: int):
-            """Fetch shard ``pos``@v from any member that holds it."""
-            for o in infos:
-                if shard_v(o, oid, pos) != v:
-                    continue
-                rep = self._read_shard_from(o, pool_id, ps, oid, pos)
-                if rep is not None and rep[0] == v:
-                    return np.frombuffer(rep[1], np.uint8)
-            return None
-
-        if code is None:
-            need = [o for o in up
-                    if shard_v(o, oid, 0) != v]
-            if not need:
-                return True
-            data = read_pos(0)
-            if data is None:
-                self.log.derr(f"pg {cid} {oid}@{v}: no reachable "
-                              f"holder")
-                return False
-            ok = True
-            for o in need:
-                if o != self.id and not self._alive(o):
-                    ok = False
-                    continue
-                self._push_shard(pool_id, ps, o, oid, 0,
-                                 data.tobytes(), size, v)
-            self.pc.inc("recovered_objects")
-            return ok
-
-        # EC: each up member needs the shard of ITS position.  Gather
-        # any k positions at version v (direct moves included), then
-        # reconstruct whatever positions lack a holder (the reference
-        # regenerates from k reads the same way).
-        n = code.get_chunk_count()
-        k = code.get_data_chunk_count()
-        need = [(pos, o) for pos, o in enumerate(up)
-                if shard_v(o, oid, pos) != v]
+        need = [o for o in up if shard_v(o, oid, 0) != v]
         if not need:
             return True
-        chunks: Dict[int, np.ndarray] = {}
-        for pos in range(n):
-            if len(chunks) >= k:
+        data = None
+        for o in infos:
+            if shard_v(o, oid, 0) != v:
+                continue
+            rep = self._read_shard_from(o, pool_id, ps, oid, 0)
+            if rep is not None and rep[0] == v:
+                data = np.frombuffer(rep[1], np.uint8)
+                size = rep[2]
                 break
-            got = read_pos(pos)
-            if got is not None:
-                chunks[pos] = got
-        if len(chunks) < k:
-            self.log.derr(f"pg {cid} {oid}@{v}: only {len(chunks)} of "
-                          f"{k} shards reachable")
+        if data is None:
+            self.log.derr(f"pg {cid} {oid}@{v}: no reachable holder")
             return False
-        want = {pos for pos, _o in need}
-        out = code.decode(want, chunks)
         ok = True
-        for pos, o in need:
+        for o in need:
             if o != self.id and not self._alive(o):
                 ok = False
                 continue
-            self._push_shard(
-                pool_id, ps, o, oid, pos,
-                np.asarray(out[pos], np.uint8).tobytes(), size, v)
+            self._push_shard(pool_id, ps, o, oid, 0, data.tobytes(),
+                             size, v)
         self.pc.inc("recovered_objects")
-        self.log.dout(5, f"recovered {cid}/{oid}@{v}")
         return ok
 
     def _push_shard(self, pool_id, ps, osd, oid, shard, data, size,
-                    v, qos: str = "recovery") -> bool:
+                    v, qos: str = "recovery", force: bool = False,
+                    expect: Optional[str] = None) -> bool:
         msg = {"type": "shard_write", "pool": pool_id, "ps": ps,
                "oid": oid, "shard": shard, "data": data.hex(),
                "size": size, "v": v, "qos_class": qos}
+        if force:
+            msg["force"] = True
+            msg["expect"] = expect
         try:
             if osd == self.id:
                 # direct: the caller is already a scheduled worker or

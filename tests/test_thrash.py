@@ -1,0 +1,192 @@
+"""Thrash stress — the qa/tasks/thrashosds.py role.
+
+Concurrent writers against replicated and EC pools while OSDs (and a
+quorum monitor) are killed and revived under them.  The invariant under
+test is the storage system's only promise: every ACKED write is
+readable afterwards, at its acked value — across failovers, peering,
+reconciliation, and RMW.  This is the systematic concurrency-stress
+story for SURVEY §5's race-detection row: the races it exercises are
+real daemon races (map install vs op dispatch, peering vs writes,
+election vs command forwarding), caught by invariant violation rather
+than a sanitizer.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.services.client import ObjectNotFound
+from ceph_tpu.services.cluster import MiniCluster
+
+THRASH_SECONDS = 12.0
+
+
+def conf():
+    c = Config()
+    c.set("osd_heartbeat_interval", 0.2)
+    c.set("osd_heartbeat_grace", 1.2)
+    c.set("mon_osd_down_out_interval", 1.5)
+    c.set("mon_lease", 0.3)
+    c.set("mon_election_timeout", 0.5)
+    return c
+
+
+class Writer(threading.Thread):
+    """Loops put/overwrite/delete over its own key space, recording
+    the last ACKED value per key; unacked attempts may or may not
+    land — both are legal."""
+
+    def __init__(self, cluster, wid, pool_id, ec):
+        super().__init__(daemon=True)
+        self.cluster = cluster
+        self.wid = wid
+        self.pool = pool_id
+        self.ec = ec
+        self.cli = cluster.client(f"thrash-w{wid}-{pool_id}")
+        self.acked = {}
+        # keys whose exact content is indeterminate: an UNACKED op may
+        # still have landed durably (reply lost after >= k shards
+        # persisted — a legal outcome), so only readability is asserted
+        # until a later fully-acked full overwrite re-determines them
+        self.dirty = set()
+        self.ops = 0
+        self.stop = threading.Event()
+
+    def run(self):
+        i = 0
+        while not self.stop.is_set():
+            key = f"w{self.wid}-k{i % 7}"
+            val = f"{self.wid}:{i}:".encode() * 40
+            op = None
+            try:
+                if self.ec and i % 3 == 2:
+                    # partial overwrite keeps base data outside range
+                    base = self.acked.get(key)
+                    if base is not None:
+                        op = "rmw"
+                        self.cli.write(self.pool, key, 8, val[:64])
+                        merged = bytearray(base)
+                        if len(merged) < 72:
+                            merged.extend(bytes(72 - len(merged)))
+                        merged[8:72] = val[:64]
+                        self.acked[key] = bytes(merged)
+                        # an acked RMW on a dirty key merges over
+                        # unknown base content: stays dirty
+                elif i % 11 == 10:
+                    op = "delete"
+                    self.cli.delete(self.pool, key)
+                    self.acked[key] = None
+                    self.dirty.discard(key)  # state fully determined
+                else:
+                    op = "put"
+                    self.cli.put(self.pool, key, val)
+                    self.acked[key] = val
+                    self.dirty.discard(key)  # full overwrite
+                self.ops += 1
+            except Exception:
+                if op is not None:
+                    self.dirty.add(key)  # may or may not have landed
+            i += 1
+        self.cli.shutdown()
+
+
+@pytest.mark.parametrize("n_mons", [1, 3])
+def test_thrash_acked_writes_survive(tmp_path, n_mons):
+    c = MiniCluster(n_osds=5, hosts=5, config=conf(),
+                    data_dir=str(tmp_path / f"m{n_mons}"),
+                    n_mons=n_mons).start()
+    try:
+        c.create_replicated_pool(1, pg_num=8, size=3)
+        c.create_ec_pool(2, "t21", {"plugin": "jerasure",
+                                    "technique": "reed_sol_van",
+                                    "k": "2", "m": "1", "w": "8"},
+                         pg_num=8)
+        writers = [Writer(c, 0, 1, ec=False),
+                   Writer(c, 1, 1, ec=False),
+                   Writer(c, 2, 2, ec=True)]
+        for w in writers:
+            w.start()
+
+        end = time.monotonic() + THRASH_SECONDS
+        victim = 0
+        while time.monotonic() < end:
+            c.kill_osd(victim)
+            try:
+                c.wait_for_down(victim, timeout=8)
+            except TimeoutError:
+                pass
+            if n_mons == 3 and victim % 2 == 0:
+                rank = 0 if victim == 0 else 1
+                if rank in c.mons and len(c.mons) == 3:
+                    c.kill_mon(rank)
+                    time.sleep(1.2)
+                    c.revive_mon(rank)
+            time.sleep(1.5)
+            c.revive_osd(victim)
+            try:
+                c.wait_for_up(victim, timeout=8)
+            except TimeoutError:
+                pass
+            victim = (victim + 1) % 5
+
+        for w in writers:
+            w.stop.set()
+        for w in writers:
+            w.join(timeout=30)
+        assert sum(w.ops for w in writers) > 30, \
+            "thrash produced too few acked ops to mean anything"
+
+        # settle: all osds up, recovery quiesced
+        for o in range(5):
+            if o not in c.osds:
+                c.revive_osd(o)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                if len(c.status()["up_osds"]) == 5:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        time.sleep(3.0)  # a peering pass after the last epoch
+
+        checker = c.client("thrash-check")
+        bad = []
+        for w in writers:
+            for key, want in w.acked.items():
+                fuzzy = key in w.dirty
+                deadline = time.monotonic() + 20
+                while True:
+                    try:
+                        if want is None and not fuzzy:
+                            try:
+                                checker.get(w.pool, key,
+                                            notfound_retries=0)
+                                got = "EXISTS"
+                            except ObjectNotFound:
+                                got = None
+                        else:
+                            try:
+                                got = checker.get(w.pool, key)
+                            except ObjectNotFound:
+                                got = None
+                        if fuzzy:
+                            # an unacked op may have landed: exact
+                            # content is indeterminate, but the object
+                            # must be READABLE (or legally absent)
+                            break
+                        if got == want:
+                            break
+                        if time.monotonic() > deadline:
+                            bad.append((w.pool, key, "mismatch"))
+                            break
+                    except Exception as e:
+                        if time.monotonic() > deadline:
+                            bad.append((w.pool, key, repr(e)))
+                            break
+                    time.sleep(0.5)
+        assert not bad, f"acked writes lost/corrupt: {bad[:5]}"
+    finally:
+        c.shutdown()
