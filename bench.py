@@ -145,12 +145,16 @@ def _stage_crush(name, plat, batch, iters, engine="xla"):
 
 
 def _try_stage(label, fn, *a, **kw):
-    """One stage must never cost the later ones — except a golden
-    mismatch, which means wrong mappings and must never be masked."""
+    """One stage must never cost the later ones.  A golden mismatch
+    (wrong mappings) is never masked — it lands as an explicit
+    BENCH_RESULT line the parent uses to refuse that engine's rate —
+    but it must not kill the OTHER engine's stages either."""
     try:
         return fn(*a, **kw)
-    except AssertionError:
-        raise
+    except AssertionError as e:
+        print(f"# stage {label} GOLDEN FAILURE: {e}", file=sys.stderr)
+        _emit(stage="golden_failure", label=label, error=str(e))
+        return None
     except Exception as e:
         print(f"# stage {label} failed: {e!r}", file=sys.stderr)
         return None
@@ -437,11 +441,22 @@ def main():
                             (time.perf_counter() - acc.t0) + 90)
                 acc.wait(lambda r: sum(
                     1 for x in acc.results if is_big(x)) >= 2, grace)
-                bigs = [r for r in acc.results if is_big(r)]
-                acc_big = max(bigs, key=lambda r: r.get("rate", 0.0))
+
+            def engine_of(label):
+                return "xla-spec" if label.startswith("spec/") \
+                    else "xla"
+
+            tainted = {engine_of(r.get("label", ""))
+                       for r in acc.results
+                       if r.get("stage") == "golden_failure"}
+            usable = lambda r: r.get("engine") not in tainted  # noqa
+            bigs = [r for r in acc.results if is_big(r)
+                    and usable(r)]
+            acc_big = max(bigs, key=lambda r: r.get("rate", 0.0)) \
+                if bigs else None
             acc_tiny = max(
                 (r for r in acc.results
-                 if is_crush(r) and not is_big(r)),
+                 if is_crush(r) and not is_big(r) and usable(r)),
                 key=lambda r: r.get("rate", 0.0), default=None)
             if acc_big is None and acc_tiny is None:
                 acc.kill("no crush stage within deadline")

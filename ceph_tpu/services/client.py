@@ -122,12 +122,17 @@ class Client(MapFollower):
                     prim = self._first_reachable(up)
                     if prim is None:
                         raise TimeoutError("no reachable primary")
-                    got = self.msgr.call(
-                        self.osd_addrs[prim],
-                        {"type": "ec_write", "pool": pool_id,
-                         "ps": ps, "oid": oid, "offset": 0,
-                         "data": data.hex(), "v": v, "full": True},
-                        timeout=20)
+                    req = {"type": "ec_write", "pool": pool_id,
+                           "ps": ps, "oid": oid, "offset": 0,
+                           "data": data.hex(), "v": v, "full": True}
+                    got = self.msgr.call(self.osd_addrs[prim], req,
+                                         timeout=20)
+                    if not got.get("ok") and \
+                            got.get("error") == "not primary" and \
+                            got.get("primary") in self.osd_addrs:
+                        got = self.msgr.call(
+                            self.osd_addrs[got["primary"]],
+                            dict(req), timeout=20)
                     if not got.get("ok"):
                         raise OSError(
                             f"ec put via osd.{prim}: {got}")

@@ -221,6 +221,20 @@ class MiniCluster:
     def status(self) -> Dict:
         return self.mon_command({"type": "status"})
 
+    def health(self) -> Dict:
+        """`ceph health` surface: HEALTH_OK/HEALTH_WARN + checks."""
+        return self.mon_command({"type": "health"})
+
+    def wait_for_health_ok(self, timeout: float = 30.0) -> Dict:
+        deadline = time.monotonic() + timeout
+        last = None
+        while time.monotonic() < deadline:
+            last = self.health()
+            if last.get("status") == "HEALTH_OK":
+                return last
+            time.sleep(0.3)
+        raise TimeoutError(f"health never OK: {last}")
+
     def wait_for_down(self, osd: int, timeout: float = 15.0) -> None:
         self._wait(lambda: osd not in self.status()["up_osds"],
                    timeout, f"osd.{osd} still up")

@@ -85,8 +85,16 @@ class Monitor:
                      ("pg_temp_set", self._fwd(self._h_pg_temp_set)),
                      ("ec_profile_set",
                       self._fwd(self._h_ec_profile_set)),
+                     ("pg_stats", self._h_pg_stats),
+                     ("health", self._h_health),
                      ("status", self._h_status)):
             self.msgr.register(t, h)
+        # PGMap role (src/mon/MgrStatMonitor / PGMap.cc): latest
+        # primary-reported state per PG — observability state, NOT part
+        # of the replicated epoch log (exactly as in the reference);
+        # OSDs broadcast stats to every member, so any mon can serve
+        # health without quorum traffic
+        self._pg_stats: Dict[Tuple[int, int], Dict] = {}
 
     # -- quorum ---------------------------------------------------------
     def set_peers(self, rank: int, addrs: List[Addr]) -> None:
@@ -404,12 +412,59 @@ class Monitor:
             self.ec_profiles[msg["name"]] = dict(msg["profile"])
         return {"epoch": self._commit(f"ec profile {msg['name']}")}
 
+    def _h_pg_stats(self, msg: Dict) -> None:
+        pgid = (int(msg["pool"]), int(msg["ps"]))
+        with self._lock:
+            cur = self._pg_stats.get(pgid)
+            if cur is None or int(msg.get("epoch", 0)) >= \
+                    int(cur.get("epoch", 0)):
+                self._pg_stats[pgid] = {
+                    "state": msg.get("state", "unknown"),
+                    "objects": int(msg.get("objects", 0)),
+                    "primary": int(msg.get("primary", -1)),
+                    "epoch": int(msg.get("epoch", 0))}
+        return None
+
+    def _pg_summary(self) -> Dict:
+        """PGMap aggregation (call under self._lock)."""
+        by_state: Dict[str, int] = {}
+        objects = 0
+        for st in self._pg_stats.values():
+            by_state[st["state"]] = by_state.get(st["state"], 0) + 1
+            objects += st["objects"]
+        total = sum(p.pg_num for p in self.map.pools.values())
+        return {"pgs_total": total,
+                "pgs_reported": len(self._pg_stats),
+                "by_state": by_state, "objects": objects}
+
+    def _h_health(self, _msg: Dict) -> Dict:
+        """HEALTH_OK / HEALTH_WARN with reasons — the `ceph health`
+        surface (src/mon/HealthMonitor.cc role)."""
+        with self._lock:
+            down = [o for o in range(self.map.max_osd)
+                    if self.map.exists(o) and not self.map.is_up(o)]
+            pgs = self._pg_summary()
+        checks = []
+        if down:
+            checks.append(f"{len(down)} osds down: {down}")
+        not_clean = {s: n for s, n in pgs["by_state"].items()
+                     if "clean" not in s}
+        if not_clean:
+            checks.append(f"pgs not clean: {not_clean}")
+        if pgs["pgs_reported"] < pgs["pgs_total"]:
+            checks.append(
+                f"{pgs['pgs_total'] - pgs['pgs_reported']} pgs never "
+                f"reported by a primary")
+        return {"status": "HEALTH_OK" if not checks else "HEALTH_WARN",
+                "checks": checks, "pgmap": pgs}
+
     def _h_status(self, _msg: Dict) -> Dict:
         with self._lock:
             up = [o for o in range(self.map.max_osd)
                   if self.map.is_up(o)]
             return {"epoch": self.map.epoch, "up_osds": up,
                     "num_pools": len(self.map.pools),
+                    "pgmap": self._pg_summary(),
                     "subscribers": sorted(self._subscribers)}
 
     # -- failure detection ------------------------------------------------

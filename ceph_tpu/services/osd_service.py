@@ -127,6 +127,7 @@ class OSDService(MapFollower):
                      ("notify", self._h_notify),
                      ("pg_poke", self._h_pg_poke),
                      ("pg_stray", self._h_pg_stray),
+                     ("pg_log_trim", self._h_pg_log_trim),
                      ("pg_purge", self._h_pg_purge),
                      ("map_update", self._h_map_update),
                      ("map_inc", self._h_map_inc),
@@ -248,6 +249,7 @@ class OSDService(MapFollower):
                 # an older one arriving late
                 cur = self.store.getattr(cid, oid, "v") \
                     if self.store.collection_exists(cid) else None
+                rollback = False
                 if cur is not None and cur.decode() > v:
                     if not msg.get("force") or (
                             msg.get("expect") is not None
@@ -256,16 +258,29 @@ class OSDService(MapFollower):
                                 "epoch": self.epoch}
                     # authoritative rollback of a torn (never-acked)
                     # higher-version shard: fall through and overwrite
+                    rollback = True
                 txn = Transaction()
                 if not self.store.collection_exists(cid):
                     txn.create_collection(cid)
                 data = bytes.fromhex(msg["data"])
                 txn.write(cid, oid, 0, data)
+                # a shorter rewrite must never leave a stale tail:
+                # chunk boundaries shift and EC decode would interleave
+                # old bytes into the new object
+                txn.truncate(cid, oid, len(data))
                 txn.setattr(cid, oid, "size",
                             str(msg["size"]).encode())
                 txn.setattr(cid, oid, "crc",
                             str(crc32c(data)).encode())
                 txn.setattr(cid, oid, "v", v.encode())
+                if rollback:
+                    # the torn entries must leave the log too, or the
+                    # per-object "newest record" (what peering and
+                    # trim consume) keeps resurrecting the rolled-back
+                    # version (PGLog::rewind_divergent)
+                    drop = self._log_keys_above(cid, msg["oid"], v)
+                    if drop:
+                        txn.omap_rmkeys(cid, "pglog", drop)
                 txn.omap_setkeys(cid, "pglog", {
                     f"{v}|{msg['shard']}": _json.dumps(
                         {"op": "write", "oid": msg["oid"],
@@ -315,10 +330,19 @@ class OSDService(MapFollower):
                     # same newer-wins guard as the write path: a stale
                     # delete (late retry racing a newer put) must not
                     # clobber the newer write's shards — the tombstone
-                    # still logs, and version merge orders them
+                    # still logs, and version merge orders them.  A
+                    # peering-driven FORCE delete removes a torn
+                    # higher-version shard too, CAS-guarded on the
+                    # version peering observed.
                     cur = self.store.getattr(cid, name, "v")
                     if cur is not None and cur.decode() > v:
-                        continue
+                        if not msg.get("force") or (
+                                msg.get("expect") is not None
+                                and cur.decode() != msg["expect"]):
+                            continue
+                        for key in self._log_keys_above(
+                                cid, msg["oid"], v):
+                            txn.omap_rmkeys(cid, "pglog", [key])
                     txn.remove(cid, name)
             txn.omap_setkeys(cid, "pglog", {
                 f"{v}|d": _json.dumps(
@@ -523,6 +547,61 @@ class OSDService(MapFollower):
     def _h_pg_info(self, msg: Dict) -> Dict:
         return self._pg_local_info(int(msg["pool"]), int(msg["ps"]))
 
+    def _log_keys_above(self, cid: str, oid: str, v: str):
+        """PG-log keys recording ``oid`` at versions above ``v`` (the
+        torn entries an authoritative rollback must erase)."""
+        import json as _json
+
+        drop = []
+        if not self.store.collection_exists(cid):
+            return drop
+        for key, raw in self.store.omap_get(cid, "pglog").items():
+            try:
+                rec = _json.loads(raw.decode())
+            except ValueError:
+                continue
+            if rec.get("oid") == oid and rec.get("v", "") > v:
+                drop.append(key)
+        return drop
+
+    def _h_pg_log_trim(self, msg: Dict) -> None:
+        """Drop log entries superseded by a newer entry for the same
+        object (PGLog::trim): the per-object newest record — tombstones
+        included — is what peering consumes; history behind it is dead
+        weight in omap space."""
+        pool_id, ps = int(msg["pool"]), int(msg["ps"])
+        cid = pg_cid(pool_id, ps)
+        import json as _json
+
+        with self._pg_lock(pool_id, ps):
+            if not self.store.collection_exists(cid):
+                return None
+            log = self.store.omap_get(cid, "pglog")
+            newest: Dict[str, str] = {}
+            for key, raw in log.items():
+                try:
+                    rec = _json.loads(raw.decode())
+                except ValueError:
+                    continue
+                oid = rec.get("oid")
+                v = rec.get("v", "")
+                if oid and v >= newest.get(oid, ""):
+                    newest[oid] = v
+            drop = []
+            for key, raw in log.items():
+                try:
+                    rec = _json.loads(raw.decode())
+                except ValueError:
+                    drop.append(key)
+                    continue
+                if rec.get("v", "") < newest.get(rec.get("oid"), ""):
+                    drop.append(key)
+            if drop:
+                txn = Transaction()
+                txn.omap_rmkeys(cid, "pglog", drop)
+                self.store.queue_transaction(txn)
+        return None
+
     def _h_pg_poke(self, _msg: Dict) -> None:
         """A peer lost a shard (scrub repair) or wants re-peering."""
         self._recover_wake.set()
@@ -544,14 +623,19 @@ class OSDService(MapFollower):
         cid = pg_cid(msg["pool"], msg["ps"])
         with self._lock:
             m = self.map
-        if m is not None:
-            up, _p, acting, _ap = m.pg_to_up_acting_osds(
-                int(msg["pool"]), int(msg["ps"]))
-            if self.id in up or self.id in acting:
-                return {"ok": False, "error": "still a member"}
-        if self.store.collection_exists(cid):
-            self.store.queue_transaction(
-                Transaction().remove_collection(cid))
+        if m is None:
+            # without a map this osd cannot know its membership — a
+            # late/duplicate purge must never delete a PG it is about
+            # to serve
+            return {"ok": False, "error": "no map yet"}
+        up, _p, acting, _ap = m.pg_to_up_acting_osds(
+            int(msg["pool"]), int(msg["ps"]))
+        if self.id in up or self.id in acting:
+            return {"ok": False, "error": "still a member"}
+        with self._pg_lock(int(msg["pool"]), int(msg["ps"])):
+            if self.store.collection_exists(cid):
+                self.store.queue_transaction(
+                    Transaction().remove_collection(cid))
         return {"ok": True}
 
     def _report_strays(self, m) -> None:
@@ -700,16 +784,21 @@ class OSDService(MapFollower):
     # -- recovery (mark-down -> remap -> recover) ----------------------
     def _recover_loop(self) -> None:
         retry_pending = False
+        last_pass = 0.0
         while self._running:
             fired = self._recover_wake.wait(timeout=5.0)
             self._recover_wake.clear()
             if not self._running:
                 break
-            if not fired and not retry_pending:
-                continue  # idle: no epoch change, nothing pending
+            if not fired and not retry_pending and \
+                    time.monotonic() - last_pass < 20.0:
+                continue  # idle; a periodic pass still runs every
+                # ~20s so pg_stats reach monitors that joined late
+                # and missed pokes self-heal
             try:
                 self._check_recovery()
                 retry_pending = False
+                last_pass = time.monotonic()
             except Exception as e:
                 self.log.derr(f"recovery pass failed: {e}")
                 retry_pending = True  # peers may come back; retry
@@ -746,17 +835,11 @@ class OSDService(MapFollower):
         way).  Cross-daemon shard pushes take only the REMOTE pg
         lock transiently — per-(osd, pg) locks cannot cycle because a
         PG has one primary."""
-        with self._pg_lock(pool_id, ps):
-            self._peer_pg_locked(m, pool_id, pool, ps, up, acting)
-
-    def _peer_pg_locked(self, m, pool_id: int, pool, ps: int,
-                        up: List[int], acting: List[int]) -> None:
-        cid = pg_cid(pool_id, ps)
-        code = self._code_for(pool)
-        # query every reachable member of up and acting PLUS reported
-        # strays (former members still holding data after a remap —
-        # the past-intervals/MOSDPGNotify role): without them, shards
-        # that remapped away from the up set would be unreachable
+        # gather infos OUTSIDE the PG lock: up to members*5s of RPC
+        # must not stall client ops; the lock-protected phase re-checks
+        # the epoch and every mutation is CAS-guarded, so stale infos
+        # degrade to no-ops, never to wrong rollbacks
+        epoch_at_gather = self.epoch
         with self._lock:
             strays = set(self._strays.get((pool_id, ps), set()))
         members = sorted({o for o in (list(up) + list(acting)
@@ -780,6 +863,21 @@ class OSDService(MapFollower):
                 # (shrinks the dual-primary window during transitions)
                 self._recover_wake.set()
                 return
+        with self._pg_lock(pool_id, ps):
+            if self.epoch != epoch_at_gather:
+                self._recover_wake.set()  # re-peer on the new map
+                return
+            # local state may have advanced while gathering (a client
+            # write completed): refresh our own info under the lock
+            infos[self.id] = self._pg_local_info(pool_id, ps)
+            self._peer_pg_locked(m, pool_id, pool, ps, up, acting,
+                                 members, strays, infos)
+
+    def _peer_pg_locked(self, m, pool_id: int, pool, ps: int,
+                        up: List[int], acting: List[int],
+                        members, strays, infos) -> None:
+        cid = pg_cid(pool_id, ps)
+        code = self._code_for(pool)
         # merge: newest version wins per object (delete tombstones
         # included) — the result of authoritative-log election + merge
         merged: Dict[str, Dict] = {}
@@ -849,10 +947,20 @@ class OSDService(MapFollower):
                         best_write is None or best_tomb > best_write):
                     for o, info in infos.items():
                         lrec = info.get("objects", {}).get(oid)
-                        if lrec and not lrec.get("deleted") \
-                                and lrec["v"] < best_tomb:
+                        if not lrec or lrec.get("deleted"):
+                            continue
+                        if lrec["v"] < best_tomb:
                             self._send_delete(pool_id, ps, o, oid,
                                               best_tomb)
+                        else:
+                            # torn never-acked shards above the
+                            # tombstone: CAS force-delete so the
+                            # delete actually wins (finishing next
+                            # pass keeps clean honest)
+                            self._send_delete(
+                                pool_id, ps, o, oid, best_tomb,
+                                force=True, expect=lrec["v"])
+                            clean = False
                     continue
                 if best_write is None:
                     if cover:
@@ -899,8 +1007,33 @@ class OSDService(MapFollower):
                     shard_v, code)
             finally:
                 self.backfill_throttle.put()
+        # PG state for the monitor's PGMap/health surface
+        n_alive = len([o for o in up if self._alive(o)])
+        want = len(up)
+        states = ["active"]
+        if n_alive < want:
+            states.append("undersized")
+        if not clean:
+            states.append("degraded")
+        else:
+            states.append("clean")
+        n_objects = len([1 for _oid, rec in merged.items()
+                         if not rec.get("deleted")])
+        self.mon_send({"type": "pg_stats", "pool": pool_id, "ps": ps,
+                       "state": "+".join(states),
+                       "objects": n_objects, "primary": self.id,
+                       "epoch": self.epoch})
         if clean:
             self._set_pg_temp(pool_id, ps, [])
+            # history behind each object's newest log record is dead
+            # weight: trim it everywhere (PGLog::trim on clean)
+            for o in members:
+                msg_t = {"type": "pg_log_trim", "pool": pool_id,
+                         "ps": ps}
+                if o == self.id:
+                    self._h_pg_log_trim(msg_t)
+                elif self._alive(o):
+                    self.msgr.send(self.osd_addrs[o], msg_t)
             # every up member holds everything: strays may drop their
             # copies (PG removal after clean)
             for o in strays:
@@ -996,9 +1129,13 @@ class OSDService(MapFollower):
                          f"need={need}")
         return ok
 
-    def _send_delete(self, pool_id, ps, osd, oid, v) -> None:
+    def _send_delete(self, pool_id, ps, osd, oid, v, force=False,
+                     expect=None) -> None:
         msg = {"type": "obj_delete", "pool": pool_id, "ps": ps,
                "oid": oid, "v": v}
+        if force:
+            msg["force"] = True
+            msg["expect"] = expect
         try:
             if osd == self.id:
                 self._h_obj_delete(msg)
