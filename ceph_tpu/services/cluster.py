@@ -125,8 +125,18 @@ class MiniCluster:
 
     def revive_mon(self, rank: int) -> Monitor:
         # rebind the original rank port so peers and daemons reach it
-        # at the address already in their quorum lists
-        mon = self._make_mon(rank, port=self.mon_addrs[rank][1])
+        # at the address already in their quorum lists (brief retry:
+        # the killed listener's socket may still be closing)
+        deadline = time.monotonic() + 5
+        while True:
+            try:
+                mon = self._make_mon(rank,
+                                     port=self.mon_addrs[rank][1])
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
         if self.n_mons > 1:
             mon.set_peers(rank, self.mon_addrs)
         mon.start()
@@ -167,6 +177,14 @@ class MiniCluster:
                      "min_size": code.get_data_chunk_count(),
                      "pg_num": pg_num, "crush_rule": self.ec_rule,
                      "erasure_code_profile": profile_name}})
+
+    def delete_pool(self, pool_id: int) -> None:
+        self.mon_command({"type": "pool_delete", "pool_id": pool_id})
+
+    def reweight_osd(self, osd: int, weight: float) -> None:
+        """`ceph osd reweight` (0.0-1.0)."""
+        self.mon_command({"type": "reweight", "osd": osd,
+                          "weight": int(weight * 0x10000)})
 
     def scrub(self, pool_id: int) -> Dict[int, list]:
         """Deep-scrub every PG of a pool on every up OSD; returns

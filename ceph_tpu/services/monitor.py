@@ -82,6 +82,8 @@ class Monitor:
                      ("mark_down", self._fwd(self._h_mark_down)),
                      ("mark_out", self._fwd(self._h_mark_out)),
                      ("pool_create", self._fwd(self._h_pool_create)),
+                     ("pool_delete", self._fwd(self._h_pool_delete)),
+                     ("reweight", self._fwd(self._h_reweight)),
                      ("pg_temp_set", self._fwd(self._h_pg_temp_set)),
                      ("ec_profile_set",
                       self._fwd(self._h_ec_profile_set)),
@@ -182,6 +184,7 @@ class Monitor:
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> None:
+        self._load_store()
         self.msgr.start()
         self._running = True
         self._ticker = threading.Thread(target=self._tick_loop,
@@ -189,8 +192,43 @@ class Monitor:
         self._ticker.start()
         if self.quorum is not None:
             self.quorum.start()
-        else:
+        elif self._committed_epoch == 0:
             self._commit("genesis")
+
+    def _load_store(self) -> None:
+        """MonitorDBStore reload: a restarted monitor resumes from its
+        persisted epochs instead of resetting to genesis (which would
+        freeze daemons already holding newer epochs).  Quorum members
+        also benefit: a rejoin starts from the local tail and syncs
+        only the delta."""
+        if not self.store_dir or not os.path.isdir(self.store_dir):
+            return
+        epochs = []
+        for name in os.listdir(self.store_dir):
+            if name.startswith("osdmap.") and name.endswith(".json"):
+                try:
+                    epochs.append(int(name.split(".")[1]))
+                except ValueError:
+                    continue
+        if not epochs:
+            return
+        keep = self.ctx.conf["mon_max_map_epochs"]
+        with self._lock:
+            for e in sorted(epochs)[-keep:]:
+                try:
+                    self._epochs[e] = open(os.path.join(
+                        self.store_dir, f"osdmap.{e}.json")).read()
+                except OSError:
+                    continue
+            newest = max(self._epochs)
+            p = json.loads(self._epochs[newest])
+            self.map = OSDMap.from_dict(p["map"])
+            self._osd_addrs = {int(k): tuple(a)
+                               for k, a in p["osd_addrs"].items()}
+            self.ec_profiles = dict(p["ec_profiles"])
+            self._prev_map = OSDMap.from_dict(p["map"])
+            self._committed_epoch = newest
+        self.log.dout(1, f"resumed from stored epoch {newest}")
 
     def shutdown(self) -> None:
         self._running = False
@@ -246,6 +284,17 @@ class Monitor:
             for e in sorted(self._epochs)[:-keep]:
                 del self._epochs[e]
                 self._incs.pop(e, None)
+                if self.store_dir:
+                    try:
+                        os.unlink(os.path.join(
+                            self.store_dir, f"osdmap.{e}.json"))
+                    except OSError:
+                        pass
+            # a deleted pool's PGs must leave the PGMap too, or stale
+            # states poison health checks forever
+            for pgid in [g for g in self._pg_stats
+                         if g[0] not in self.map.pools]:
+                del self._pg_stats[pgid]
             if self.store_dir:
                 os.makedirs(self.store_dir, exist_ok=True)
                 with open(os.path.join(
@@ -406,6 +455,31 @@ class Monitor:
         with self._lock:
             self.map.pools[pool_id] = PgPool(**msg["pool"])
         return {"epoch": self._commit(f"pool {pool_id} create")}
+
+    def _h_pool_delete(self, msg: Dict) -> Dict:
+        """Pool removal (OSDMonitor prepare_pool_op delete): rides the
+        incremental's old_pools delta; daemons drop the pool's PGs on
+        the next map."""
+        pool_id = int(msg["pool_id"])
+        with self._lock:
+            if pool_id not in self.map.pools:
+                return {"error": f"no pool {pool_id}"}
+            del self.map.pools[pool_id]
+            for pgid in [g for g in self.map.pg_temp
+                         if g[0] == pool_id]:
+                del self.map.pg_temp[pgid]
+        return {"epoch": self._commit(f"pool {pool_id} delete")}
+
+    def _h_reweight(self, msg: Dict) -> Dict:
+        """`ceph osd reweight` (0.0-1.0 override weight)."""
+        osd = int(msg["osd"])
+        w = int(msg["weight"])  # 16.16 fixed point
+        with self._lock:
+            if not self.map.exists(osd):
+                return {"error": f"no osd.{osd}"}
+            self.map.osd_weight[osd] = max(0, min(0x10000, w))
+            self._auto_out.pop(osd, None)
+        return {"epoch": self._commit(f"osd.{osd} reweight")}
 
     def _h_ec_profile_set(self, msg: Dict) -> Dict:
         with self._lock:

@@ -324,6 +324,7 @@ class OSDService(MapFollower):
                 txn.create_collection(cid)
             else:
                 prefix = f"{msg['oid']}.s"
+                torn_cleanup = False
                 for name in self.store.list_objects(cid):
                     if not name.startswith(prefix):
                         continue
@@ -340,10 +341,12 @@ class OSDService(MapFollower):
                                 msg.get("expect") is not None
                                 and cur.decode() != msg["expect"]):
                             continue
-                        for key in self._log_keys_above(
-                                cid, msg["oid"], v):
-                            txn.omap_rmkeys(cid, "pglog", [key])
+                        torn_cleanup = True
                     txn.remove(cid, name)
+                if torn_cleanup:
+                    drop = self._log_keys_above(cid, msg["oid"], v)
+                    if drop:
+                        txn.omap_rmkeys(cid, "pglog", drop)
             txn.omap_setkeys(cid, "pglog", {
                 f"{v}|d": _json.dumps(
                     {"op": "delete", "oid": msg["oid"],
@@ -632,11 +635,21 @@ class OSDService(MapFollower):
             int(msg["pool"]), int(msg["ps"]))
         if self.id in up or self.id in acting:
             return {"ok": False, "error": "still a member"}
-        with self._pg_lock(int(msg["pool"]), int(msg["ps"])):
-            if self.store.collection_exists(cid):
-                self.store.queue_transaction(
-                    Transaction().remove_collection(cid))
+        self._drop_pg_collection(int(msg["pool"]), int(msg["ps"]))
         return {"ok": True}
+
+    def _drop_pg_collection(self, pool_id: int, ps: int) -> None:
+        """Remove a whole PG (objects first: ObjectStore refuses to
+        drop non-empty collections) under the PG lock."""
+        cid = pg_cid(pool_id, ps)
+        with self._pg_lock(pool_id, ps):
+            if not self.store.collection_exists(cid):
+                return
+            txn = Transaction()
+            for name in self.store.list_objects(cid):
+                txn.remove(cid, name)
+            txn.remove_collection(cid)
+            self.store.queue_transaction(txn)
 
     def _report_strays(self, m) -> None:
         """Per epoch: any local PG collection this osd no longer
@@ -648,6 +661,9 @@ class OSDService(MapFollower):
             except ValueError:
                 continue
             if pool_id not in m.pools:
+                # the pool was deleted: its PGs go with it (the
+                # reference's PG removal on pool delete)
+                self._drop_pg_collection(pool_id, ps)
                 continue
             up, _p, acting, _ap = m.pg_to_up_acting_osds(pool_id, ps)
             if self.id in up or self.id in acting:
