@@ -70,6 +70,12 @@ OPTIONS: Dict[str, Option] = _opts(
            "jerasure isa lrc shec clay", "plugins loaded at start"),
     Option("mon_max_map_epochs", int, 500,
            "full OSDMap epochs retained by the map store"),
+    Option("osd_scrub_interval", float, 300.0,
+           "seconds between automatic deep scrubs of each PG "
+           "(osd_deep_scrub_interval role); 0 disables"),
+    Option("osd_scrub_auto_repair", bool, True,
+           "drop shards whose stored crc32c mismatches so recovery "
+           "re-decodes them from survivors"),
     Option("mon_lease", float, 0.6,
            "quorum leader lease interval; peons call an election "
            "after 3 missed leases"),

@@ -106,6 +106,9 @@ class OSDService(MapFollower):
         # queries them so shards that remapped AWAY from the up set
         # stay reachable, and purges them once the PG is clean
         self._strays: Dict[Tuple[int, int], Set[int]] = {}
+        # (pool, ps) -> monotonic time of the last scheduled deep
+        # scrub this primary ran (PG::sched_scrub role)
+        self._last_scrub: Dict[Tuple[int, int], float] = {}
         # dmClock QoS at the store door: client vs recovery vs scrub
         # ops are served in tag order by a small worker pool
         self.sched = OpScheduler(n_workers=2)
@@ -739,22 +742,27 @@ class OSDService(MapFollower):
     def _do_pg_scrub(self, msg: Dict) -> Dict:
         """Deep scrub of one PG: recompute every local shard's crc32c
         and compare with the stored write-time digest (the
-        HashInfo-backed scrub of the reference's deep-scrub flow)."""
+        HashInfo-backed scrub of the reference's deep-scrub flow).
+        Each object's (data, crc) pair reads under the PG lock: a
+        racing write commits both in one transaction, and reading them
+        torn would flag — and auto-repair would DROP — a healthy
+        shard."""
         from ..ec.stripe import crc32c
 
         cid = pg_cid(msg["pool"], msg["ps"])
         inconsistent: List[str] = []
         digests: Dict[str, int] = {}
-        if self.store.collection_exists(cid):
-            for name in self.store.list_objects(cid):
-                if name == "pglog":
-                    continue
-                data = self.store.read(cid, name)
-                got = crc32c(data)
-                stored = self.store.getattr(cid, name, "crc")
-                digests[name] = got
-                if stored is not None and int(stored) != got:
-                    inconsistent.append(name)
+        with self._pg_lock(int(msg["pool"]), int(msg["ps"])):
+            if self.store.collection_exists(cid):
+                for name in self.store.list_objects(cid):
+                    if name == "pglog":
+                        continue
+                    data = self.store.read(cid, name)
+                    got = crc32c(data)
+                    stored = self.store.getattr(cid, name, "crc")
+                    digests[name] = got
+                    if stored is not None and int(stored) != got:
+                        inconsistent.append(name)
         return {"osd": self.id, "inconsistent": inconsistent,
                 "digests": digests}
 
@@ -837,6 +845,64 @@ class OSDService(MapFollower):
                 if not members or members[0] != self.id:
                     continue  # peering + recovery are the primary's job
                 self._peer_pg(m, pool_id, pool, ps, up, acting)
+                self._maybe_scrub(pool_id, ps, up)
+
+    def _maybe_scrub(self, pool_id: int, ps: int,
+                     up: List[int]) -> None:
+        """Scheduled deep scrub (PG::sched_scrub / osd_scrub_* role):
+        the primary periodically asks every member to recompute shard
+        digests; mismatching shards are dropped (auto-repair) so the
+        next peering pass re-decodes them from survivors."""
+        interval = self.ctx.conf["osd_scrub_interval"]
+        if interval <= 0:
+            return
+        key = (pool_id, ps)
+        now = time.monotonic()
+        if key not in self._last_scrub:
+            # jittered first deadline: without it every PG scrubs on
+            # the first pass after (re)start and the whole cluster
+            # stays phase-aligned forever (the reference randomizes
+            # scrub deadlines for the same reason)
+            import random
+
+            self._last_scrub[key] = now - random.random() * interval
+            return
+        if now - self._last_scrub[key] < interval:
+            return
+        self._last_scrub[key] = now
+        repair = self.ctx.conf["osd_scrub_auto_repair"]
+        for o in up:
+            if o == self.id:
+                # through the scheduler like remote scrubs: scrub I/O
+                # is dmClock-tagged on every member equally
+                got = self._h_pg_scrub({"pool": pool_id, "ps": ps})
+            elif self._alive(o):
+                try:
+                    got = self.msgr.call(
+                        self.osd_addrs[o],
+                        {"type": "pg_scrub", "pool": pool_id,
+                         "ps": ps}, timeout=10)
+                except (TimeoutError, OSError):
+                    continue
+            else:
+                continue
+            for name in got.get("inconsistent", []):
+                self.log.derr(f"scrub: pg {pool_id}.{ps} {name} "
+                              f"crc mismatch on osd.{o}")
+                if not repair:
+                    continue
+                oid, _, shard = name.rpartition(".s")
+                msg = {"type": "shard_remove", "pool": pool_id,
+                       "ps": ps, "oid": oid, "shard": int(shard)}
+                try:
+                    if o == self.id:
+                        self._h_shard_remove(msg)
+                    else:
+                        self.msgr.call(self.osd_addrs[o], msg,
+                                       timeout=5)
+                except (TimeoutError, OSError):
+                    pass
+                self._recover_wake.set()
 
     # -- peering (PeeringState / PGLog roles) --------------------------
     def _peer_pg(self, m, pool_id: int, pool, ps: int,

@@ -510,3 +510,53 @@ def test_pg_log_trim(cluster):
         trimmed = bool(counts) and all(n <= 2 for n in counts)
         _time.sleep(0.5)
     assert trimmed, f"log never trimmed: {counts}"
+
+
+def test_scheduled_scrub_auto_repairs(tmp_path):
+    """Periodic deep scrub (no manual scrub call): a corrupted shard
+    is detected by the scheduled pass, dropped, and re-decoded."""
+    import time as _time
+
+    from ceph_tpu.common.config import Config as _Config
+    from ceph_tpu.services.cluster import MiniCluster as _MC
+    from ceph_tpu.services.client import object_to_ps
+    from ceph_tpu.ec.stripe import crc32c as _crc
+
+    conf = _Config()
+    conf.set("osd_heartbeat_interval", 0.3)
+    conf.set("osd_heartbeat_grace", 3.0)
+    conf.set("osd_scrub_interval", 2.0)
+    c = _MC(n_osds=4, config=conf).start()
+    try:
+        c.create_ec_pool(2, "sk21", {"plugin": "jerasure",
+                                     "technique": "reed_sol_van",
+                                     "k": "2", "m": "1", "w": "8"},
+                         pg_num=8)
+        cli = c.client("sched-scrub")
+        data = b"scheduled-scrub " * 120
+        cli.put(2, "ss-obj", data)
+        c.wait_for_recovery(2, {"ss-obj": None}, timeout=20)
+
+        ps = object_to_ps("ss-obj") % 8
+        payload = c.mon_command({"type": "get_map"})
+        from ceph_tpu.osdmap.osdmap import OSDMap as _OM
+        m = _OM.from_dict(payload["map"])
+        up, _p, _a, _ap = m.pg_to_up_acting_osds(2, ps)
+        victim = c.osds[up[1]]
+        cid = f"2.{ps}"
+        victim.store._coll[cid]["ss-obj.s1"].data[3] ^= 0x5A
+
+        # no manual scrub: the scheduled pass must find and fix it
+        deadline = _time.monotonic() + 40
+        fixed = False
+        while _time.monotonic() < deadline and not fixed:
+            obj = victim.store._coll.get(cid, {}).get("ss-obj.s1")
+            if obj is not None:
+                stored = victim.store.getattr(cid, "ss-obj.s1", "crc")
+                fixed = stored is not None and \
+                    int(stored) == _crc(bytes(obj.data))
+            _time.sleep(0.5)
+        assert fixed, "scheduled scrub never repaired the shard"
+        assert cli.get(2, "ss-obj") == data
+    finally:
+        c.shutdown()
