@@ -870,6 +870,15 @@ class OSDService(MapFollower):
         if now - self._last_scrub[key] < interval:
             return
         self._last_scrub[key] = now
+        # off the recovery thread: a slow member's 10s scrub RPC must
+        # never delay re-peering of other PGs (the stamp above already
+        # prevents overlapping sweeps of the same PG)
+        threading.Thread(target=self._scrub_pg,
+                         args=(pool_id, ps, list(up)), daemon=True,
+                         name=f"osd{self.id}-scrub").start()
+
+    def _scrub_pg(self, pool_id: int, ps: int,
+                  up: List[int]) -> None:
         repair = self.ctx.conf["osd_scrub_auto_repair"]
         for o in up:
             if o == self.id:

@@ -1,0 +1,131 @@
+"""ceph — the cluster admin CLI.
+
+The `ceph` command role (src/ceph.in + the mon command surface):
+status/health/df, osd tree/reweight/out/down, pool create/delete/ls —
+all against a running cluster's monitor address (quorum lists accepted
+as comma-separated host:port pairs).
+
+CLI:
+    python -m ceph_tpu.tools.ceph_cli --mon HOST:PORT[,HOST:PORT...] \
+        status | health | osd tree | osd reweight ID W | osd out ID |
+        osd down ID | pool ls | pool create ID PGS SIZE | pool delete ID
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..msg.messenger import Messenger
+from ..services.map_follower import failover_call
+
+
+def _mons(spec: str):
+    out = []
+    for part in spec.split(","):
+        host, port = part.rsplit(":", 1)
+        out.append((host, int(port)))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ceph")
+    ap.add_argument("--mon", required=True,
+                    help="monitor address(es), host:port[,host:port]")
+    ap.add_argument("--keyring", help="cluster key (hex)")
+    ap.add_argument("verb", nargs="+")
+    args = ap.parse_args(argv)
+
+    kr = None
+    if args.keyring:
+        from ..msg.auth import Keyring
+
+        kr = Keyring.from_hex(args.keyring)
+    msgr = Messenger("ceph-cli", keyring=kr)
+    msgr.start()
+    mons = _mons(args.mon)
+
+    def call(msg, timeout=10.0):
+        rep, _ = failover_call(msgr, mons, msg, timeout=timeout)
+        return rep
+
+    def mutate(rep) -> int:
+        """Mutation verbs honor the exit-code contract: a monitor
+        error reply is a failure, not a success with sad JSON."""
+        print(json.dumps(rep))
+        return 1 if isinstance(rep, dict) and rep.get("error") else 0
+
+    v = args.verb
+    rc = 0
+    try:
+        if v[0] == "status":
+            st = call({"type": "status"})
+            h = call({"type": "health"})
+            pg = st.get("pgmap", {})
+            print(f"  health:  {h.get('status')}")
+            for chk in h.get("checks", []):
+                print(f"           {chk}")
+            print(f"  epoch:   {st.get('epoch')}")
+            print(f"  osds:    {len(st.get('up_osds', []))} up "
+                  f"{st.get('up_osds')}")
+            print(f"  pools:   {st.get('num_pools')}")
+            print(f"  pgs:     {pg.get('pgs_reported')}/"
+                  f"{pg.get('pgs_total')} reported "
+                  f"{pg.get('by_state')}")
+            print(f"  objects: {pg.get('objects')}")
+        elif v[0] == "health":
+            h = call({"type": "health"})
+            print(h["status"])
+            for chk in h.get("checks", []):
+                print(f"  {chk}")
+            if h["status"] != "HEALTH_OK":
+                return 1
+        elif v[0] == "df":
+            st = call({"type": "status"})
+            print(json.dumps(st.get("pgmap", {}), indent=1))
+        elif v[:2] == ["osd", "tree"]:
+            payload = call({"type": "get_map"})
+            from ..crush.map import CrushMap
+            from ..crush.wrapper import CrushWrapper
+            from .crushtool import cmd_tree
+
+            w = CrushWrapper(CrushMap.from_dict(
+                payload["map"]["crush"]))
+            cmd_tree(w, sys.stdout)
+        elif v[:2] == ["osd", "reweight"] and len(v) == 4:
+            rc = mutate(call({"type": "reweight", "osd": int(v[2]),
+                              "weight": int(float(v[3]) * 0x10000)}))
+        elif v[:2] == ["osd", "out"] and len(v) == 3:
+            rc = mutate(call({"type": "mark_out", "osd": int(v[2])}))
+        elif v[:2] == ["osd", "down"] and len(v) == 3:
+            rc = mutate(call({"type": "mark_down",
+                              "osd": int(v[2])}))
+        elif v[:2] == ["pool", "ls"]:
+            payload = call({"type": "get_map"})
+            for pid, pool in sorted(payload["map"]["pools"].items(),
+                                    key=lambda kv: int(kv[0])):
+                print(f"pool {pid}: type {pool['pool_type']} "
+                      f"size {pool['size']} pg_num {pool['pg_num']}")
+        elif v[:2] == ["pool", "create"] and len(v) == 5:
+            rc = mutate(call(
+                {"type": "pool_create", "pool_id": int(v[2]),
+                 "pool": {"pool_type": 1,
+                          "size": int(v[4]),
+                          "min_size": max(1, int(v[4]) - 1),
+                          "pg_num": int(v[3]),
+                          "crush_rule": 0}}))
+        elif v[:2] == ["pool", "delete"] and len(v) == 3:
+            rc = mutate(call({"type": "pool_delete",
+                              "pool_id": int(v[2])}))
+        else:
+            print(f"unknown or incomplete verb: {' '.join(v)}",
+                  file=sys.stderr)
+            return 2
+    finally:
+        msgr.shutdown()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

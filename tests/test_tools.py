@@ -320,3 +320,53 @@ def test_rados_cli_and_objectstore_tool(tmp_path):
         w2.mount()
         assert set(w2.list_objects(pgid)) == set(listing[pgid])
         w2.umount()
+
+
+def test_ceph_cli(capsys):
+    """The `ceph` admin CLI: status/health/osd tree/pool verbs against
+    a live cluster by monitor address."""
+    from ceph_tpu.common.config import Config
+    from ceph_tpu.services.cluster import MiniCluster
+    from ceph_tpu.tools import ceph_cli
+
+    conf = Config()
+    conf.set("osd_heartbeat_interval", 0.3)
+    conf.set("osd_heartbeat_grace", 3.0)
+    c = MiniCluster(n_osds=3, config=conf).start()
+    try:
+        c.create_replicated_pool(1, pg_num=4, size=2)
+        mon = f"{c.mon.addr[0]}:{c.mon.addr[1]}"
+        assert ceph_cli.main(["--mon", mon, "status"]) == 0
+        out = capsys.readouterr().out
+        assert "osds:    3 up" in out and "pools:   1" in out
+
+        assert ceph_cli.main(["--mon", mon, "osd", "tree"]) == 0
+        out = capsys.readouterr().out
+        # the wire map carries structure, not the builder's name maps
+        assert "root" in out and "host" in out
+        assert any(ln.strip().startswith("0\t")
+                   for ln in out.splitlines())
+
+        assert ceph_cli.main(["--mon", mon, "pool", "create", "5",
+                              "4", "2"]) == 0
+        capsys.readouterr()
+        assert ceph_cli.main(["--mon", mon, "pool", "ls"]) == 0
+        out = capsys.readouterr().out
+        assert "pool 5:" in out
+        assert ceph_cli.main(["--mon", mon, "pool", "delete",
+                              "5"]) == 0
+        capsys.readouterr()
+        assert ceph_cli.main(["--mon", mon, "osd", "reweight", "1",
+                              "0.5"]) == 0
+        capsys.readouterr()
+        payload = c.mon_command({"type": "get_map"})
+        assert payload["map"]["osd_weight"][1] == 0x8000
+
+        # health returns nonzero on WARN
+        c.kill_osd(2)
+        c.wait_for_down(2, timeout=10)
+        rc = ceph_cli.main(["--mon", mon, "health"])
+        out = capsys.readouterr().out
+        assert rc == 1 and "HEALTH_WARN" in out
+    finally:
+        c.shutdown()
