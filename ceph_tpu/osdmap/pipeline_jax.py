@@ -144,8 +144,28 @@ class PoolMapper:
 
         cargs = m.crush.choose_args.get(pool_id)
         if pool.crush_rule in m.crush.rules:
-            single, static, arrays = make_single_fn(
-                m.crush, pool.crush_rule, R, choose_args=cargs)
+            # the speculative lowering (mapper_spec) is bit-exact and
+            # ~an order of magnitude faster where eligible (straw2
+            # take/chooseleaf-firstn/emit, modern tunables) — the
+            # balancer's mutate-remap loop and osdmaptool sweeps live
+            # on this path; everything else takes the general rule VM.
+            # CEPH_TPU_SPEC_PIPELINE=0 forces the general mapper.
+            import os as _os
+
+            single = None
+            if _os.environ.get("CEPH_TPU_SPEC_PIPELINE", "1") != "0":
+                from ..crush.mapper_spec import (Ineligible,
+                                                 make_single_spec)
+
+                try:
+                    single, static, arrays = make_single_spec(
+                        m.crush, pool.crush_rule, R,
+                        choose_args=cargs, k_tries=1)
+                except Ineligible:
+                    single = None
+            if single is None:
+                single, static, arrays = make_single_fn(
+                    m.crush, pool.crush_rule, R, choose_args=cargs)
             self.arrays = jax.tree_util.tree_map(jnp.asarray, arrays)
         else:
             single = None

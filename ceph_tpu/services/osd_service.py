@@ -107,8 +107,10 @@ class OSDService(MapFollower):
         # stay reachable, and purges them once the PG is clean
         self._strays: Dict[Tuple[int, int], Set[int]] = {}
         # (pool, ps) -> monotonic time of the last scheduled deep
-        # scrub this primary ran (PG::sched_scrub role)
+        # scrub this primary ran (PG::sched_scrub role); the semaphore
+        # is the osd_max_scrubs=1 concurrency cap
         self._last_scrub: Dict[Tuple[int, int], float] = {}
+        self._scrub_slots = threading.Semaphore(1)
         # dmClock QoS at the store door: client vs recovery vs scrub
         # ops are served in tag order by a small worker pool
         self.sched = OpScheduler(n_workers=2)
@@ -879,6 +881,20 @@ class OSDService(MapFollower):
 
     def _scrub_pg(self, pool_id: int, ps: int,
                   up: List[int]) -> None:
+        # one sweep at a time (osd_max_scrubs role): a backlog of due
+        # PGs after a stall trickles out instead of flooding every
+        # member's scheduler at once
+        with self._scrub_slots:
+            try:
+                self._scrub_pg_inner(pool_id, ps, up)
+            except Exception as e:
+                self.log.derr(f"scrub pg {pool_id}.{ps} failed: "
+                              f"{e!r}")
+                # retry at the next pass, not a full interval later
+                self._last_scrub[(pool_id, ps)] =                     time.monotonic() -                     self.ctx.conf["osd_scrub_interval"]
+
+    def _scrub_pg_inner(self, pool_id: int, ps: int,
+                        up: List[int]) -> None:
         repair = self.ctx.conf["osd_scrub_auto_repair"]
         for o in up:
             if o == self.id:
