@@ -60,6 +60,7 @@ from .map_arrays import encode_map  # noqa: E402
 I32 = jnp.int32
 U32 = jnp.uint32
 NONE = C.CRUSH_ITEM_NONE
+UNDEF = C.CRUSH_ITEM_UNDEF
 
 # per-k try status codes
 _DESC = 0     # still descending
@@ -80,6 +81,7 @@ class Plan:
     numrep: int
     type_: int           # target type of the choose step
     leafy: bool          # chooseleaf (recurse to device) vs choose type 0
+    firstn: bool         # firstn (compacting) vs indep (positional)
     tries: int           # outer retry budget (choose_total_tries + 1 rule)
     recurse_tries: int   # inner retry budget (1 under descend_once)
     vary_r: int
@@ -159,10 +161,16 @@ def analyze(cmap: CrushMap, ruleno: int, result_max: int) -> Plan:
             if arg1 >= 0 or cmap.bucket_by_id(arg1) is None:
                 raise Ineligible("take target is not an existing bucket")
             root = -1 - arg1
-        elif op in (C.CRUSH_RULE_CHOOSELEAF_FIRSTN, C.CRUSH_RULE_CHOOSE_FIRSTN):
+        elif op in (C.CRUSH_RULE_CHOOSELEAF_FIRSTN,
+                    C.CRUSH_RULE_CHOOSE_FIRSTN,
+                    C.CRUSH_RULE_CHOOSELEAF_INDEP,
+                    C.CRUSH_RULE_CHOOSE_INDEP):
             if root is None or choose is not None:
                 raise Ineligible("choose without take / multiple chooses")
-            leafy = op == C.CRUSH_RULE_CHOOSELEAF_FIRSTN
+            leafy = op in (C.CRUSH_RULE_CHOOSELEAF_FIRSTN,
+                           C.CRUSH_RULE_CHOOSELEAF_INDEP)
+            firstn = op in (C.CRUSH_RULE_CHOOSELEAF_FIRSTN,
+                            C.CRUSH_RULE_CHOOSE_FIRSTN)
             numrep = arg1
             if numrep <= 0:
                 numrep += result_max
@@ -171,8 +179,16 @@ def analyze(cmap: CrushMap, ruleno: int, result_max: int) -> Plan:
             if numrep > 16:
                 raise Ineligible("numrep unroll bound exceeded")
             if not leafy and arg2 != 0:
-                raise Ineligible("choose firstn of a non-device type")
-            choose = (numrep, arg2, leafy)
+                raise Ineligible("choose of a non-device type")
+            if not firstn and leafy and arg2 == 0:
+                # the reference writes the candidate device into out2
+                # BEFORE the is_out check here (mapper.c:772-776), so
+                # an all-rejected slot leaks its last rejected device
+                # into the result; reproducing that quirk isn't worth
+                # the complexity — fall back to the general VM
+                raise Ineligible("chooseleaf indep of type 0 "
+                                 "(out2 pre-is_out leak quirk)")
+            choose = (numrep, arg2, leafy, firstn)
         elif op == C.CRUSH_RULE_EMIT:
             if choose is None:
                 raise Ineligible("emit without choose")
@@ -181,17 +197,20 @@ def analyze(cmap: CrushMap, ruleno: int, result_max: int) -> Plan:
             raise Ineligible(f"unsupported step op {op}")
     if not emitted:
         raise Ineligible("rule never emits")
-    if local_retries != 0 or local_fb != 0:
+    numrep, type_, leafy, firstn = choose
+    if firstn and (local_retries != 0 or local_fb != 0):
+        # indep has no local-retry paths at all (mapper.c:633-821),
+        # so legacy local tunables only disqualify firstn rules
         raise Ineligible("legacy local retry tunables in force")
-
-    numrep, type_, leafy = choose
     if leafy:
         if choose_leaf_tries:
             recurse_tries = choose_leaf_tries
-        elif t.chooseleaf_descend_once:
+        elif firstn and t.chooseleaf_descend_once:
             recurse_tries = 1
-        else:
+        elif firstn:
             recurse_tries = choose_tries
+        else:
+            recurse_tries = 1  # indep default (mapper_jax:692)
     else:
         recurse_tries = 1
     if recurse_tries > 4:
@@ -204,7 +223,8 @@ def analyze(cmap: CrushMap, ruleno: int, result_max: int) -> Plan:
                   if b.type == type_]
         depth_inner = max(depths) if depths else 1
     return Plan(root_idx=root, numrep=numrep, type_=type_, leafy=leafy,
-                tries=choose_tries, recurse_tries=recurse_tries,
+                firstn=firstn, tries=choose_tries,
+                recurse_tries=recurse_tries,
                 vary_r=vary_r, stable=stable,
                 depth_outer=depth_outer, depth_inner=depth_inner)
 
@@ -289,14 +309,16 @@ def make_single_spec(cmap: CrushMap, ruleno: int, result_max: int,
                        axis=1)
 
     def descend(A, rw, x, start, r, pos, want_type, levels):
-        """K pure descents: from bucket indices ``start`` choose with rank
-        ``r`` per level until an item of ``want_type`` appears
-        (mapper.c:497-546 minus the retry paths analyze() ruled out).
-        Returns (status (K,), item (K,), item_bidx (K,))."""
+        """Lane-parallel pure descents: from bucket indices ``start``
+        choose with rank ``r`` per level until an item of ``want_type``
+        appears (mapper.c:497-546 minus the retry paths analyze()
+        ruled out).  Lane count = len(start) — K speculative tries for
+        firstn, numrep slots for indep.
+        Returns (status, item, item_bidx), each start-shaped."""
         cur = start
-        status = jnp.zeros((K,), I32)
-        fitem = jnp.zeros((K,), I32)
-        fcidx = jnp.zeros((K,), I32)
+        status = jnp.zeros_like(start)
+        fitem = jnp.zeros_like(start)
+        fcidx = jnp.zeros_like(start)
         for _ in range(levels):
             item = straw2_k(A, rw, x, cur, r, pos)
             empty = A.size[cur] == 0
@@ -328,6 +350,83 @@ def make_single_spec(cmap: CrushMap, ruleno: int, result_max: int,
                     | is_out(weight, dev, x))
         return jnp.where(bad, _FAIL, st), dev
 
+    def single_indep(A, weight, x, rw):
+        """crush_choose_indep (mapper.c:633-821) as dense rounds: the
+        breadth-first structure is already a batch — every open slot's
+        descent vectorizes, with a sequential unrolled commit pass that
+        reproduces the reference's in-round collision ordering (slot j
+        sees slots < j placed this round).  Positional: failed slots
+        stay NONE."""
+        NR = min(plan.numrep, R)
+        js = jnp.arange(plan.numrep, dtype=I32)
+        out = jnp.full(R, UNDEF, I32)    # hosts
+        out2 = jnp.full(R, UNDEF, I32)   # devices
+        root_vec = jnp.full((plan.numrep,), plan.root_idx, I32)
+        pos0 = jnp.int32(0)  # the C passes outpos (0 here) as position
+
+        def round_cond(st):
+            ftotal, left, out, out2 = st
+            return (left > 0) & (ftotal < plan.tries)
+
+        def round_body(st):
+            ftotal, left, out, out2 = st
+            # straw2-only: no uniform buckets, so the rank multiplier
+            # is always numrep (mapper.c:653-660)
+            r = (js + plan.numrep * ftotal).astype(I32)
+            ost, host, hidx = descend(A, rw, x, root_vec, r, pos0,
+                                      plan.type_, plan.depth_outer)
+            found = ost == _OK
+            if plan.leafy and plan.type_ > 0:
+                # inner: rep=slot, parent_r=r, single round under the
+                # default recurse budget (r_in = slot + r + n*ft_in)
+                dev = jnp.zeros_like(host)
+                got = jnp.zeros((plan.numrep,), bool)
+                dead = jnp.zeros((plan.numrep,), bool)
+                for t_in in range(plan.recurse_tries):
+                    # the inner's choose_args position is the SLOT
+                    # index (the recursion's outpos param,
+                    # mapper_jax.py:546), vectorized per lane; no
+                    # device dedup: the inner indep's collide segment
+                    # is its own single slot (mapper_jax.py:508-516)
+                    ist, d = leaf_try(
+                        A, rw, weight, x, hidx,
+                        (js + r + plan.numrep * t_in).astype(I32),
+                        js, out2, jnp.int32(0))
+                    take = found & ~got & ~dead & (ist == _OK)
+                    dev = jnp.where(take, d, dev)
+                    got = got | take
+                    dead = dead | (~got & (ist == _SKIP))
+                cand = found & got
+            else:
+                dev = host
+                cand = found & ~is_out(weight, host, x)
+
+            # sequential commit: the C fills slots in order, so slot
+            # j's collision check sees this round's earlier placements
+            idx = jnp.arange(R, dtype=I32)
+            for j in range(NR):
+                slot_open = out[j] == UNDEF
+                collide = jnp.any((idx < NR) & (out == host[j]))
+                place = cand[j] & slot_open & ~collide
+                term = (ost[j] == _SKIP) & slot_open
+                out = jnp.where(place | term,
+                                out.at[j].set(jnp.where(place, host[j],
+                                                        NONE)), out)
+                out2 = jnp.where(place | term,
+                                 out2.at[j].set(jnp.where(place, dev[j],
+                                                          NONE)), out2)
+                left = left - (place | term).astype(I32)
+            return ftotal + 1, left, out, out2
+
+        st = (jnp.int32(0), jnp.int32(NR), out, out2)
+        _, _, out, out2 = lax.while_loop(round_cond, round_body, st)
+        result = out2 if plan.leafy else out
+        idx = jnp.arange(R, dtype=I32)
+        result = jnp.where(idx < NR,
+                           jnp.where(result == UNDEF, NONE, result),
+                           NONE)
+        return result, jnp.int32(NR)
+
     def single(A, weight, x):
         # weight reciprocals: unbatched under vmap (depend only on A), so
         # they are computed once per launch, not per lane
@@ -335,6 +434,8 @@ def make_single_spec(cmap: CrushMap, ruleno: int, result_max: int,
         if use_table:
             rw = recip64(A.arg_weights, xp=jnp) if static.has_choose_args \
                 else recip64(A.weights, xp=jnp)
+        if not plan.firstn:
+            return single_indep(A, weight, x, rw)
         out = jnp.full(R, NONE, I32)
         out2 = jnp.full(R, NONE, I32)
         outpos = jnp.int32(0)

@@ -871,27 +871,31 @@ class OSDService(MapFollower):
             return
         if now - self._last_scrub[key] < interval:
             return
+        # one sweep at a time (osd_max_scrubs role), claimed BEFORE
+        # spawning: a backlog of due PGs stays due (unstamped) instead
+        # of piling up blocked threads that later run with stale
+        # membership
+        if not self._scrub_slots.acquire(blocking=False):
+            return
         self._last_scrub[key] = now
         # off the recovery thread: a slow member's 10s scrub RPC must
-        # never delay re-peering of other PGs (the stamp above already
-        # prevents overlapping sweeps of the same PG)
+        # never delay re-peering of other PGs
         threading.Thread(target=self._scrub_pg,
                          args=(pool_id, ps, list(up)), daemon=True,
                          name=f"osd{self.id}-scrub").start()
 
     def _scrub_pg(self, pool_id: int, ps: int,
                   up: List[int]) -> None:
-        # one sweep at a time (osd_max_scrubs role): a backlog of due
-        # PGs after a stall trickles out instead of flooding every
-        # member's scheduler at once
-        with self._scrub_slots:
-            try:
-                self._scrub_pg_inner(pool_id, ps, up)
-            except Exception as e:
-                self.log.derr(f"scrub pg {pool_id}.{ps} failed: "
-                              f"{e!r}")
-                # retry at the next pass, not a full interval later
-                self._last_scrub[(pool_id, ps)] =                     time.monotonic() -                     self.ctx.conf["osd_scrub_interval"]
+        try:
+            self._scrub_pg_inner(pool_id, ps, up)
+        except Exception as e:
+            self.log.derr(f"scrub pg {pool_id}.{ps} failed: {e!r}")
+            # retry at the next pass, not a full interval later
+            interval = self.ctx.conf["osd_scrub_interval"]
+            self._last_scrub[(pool_id, ps)] = \
+                time.monotonic() - interval
+        finally:
+            self._scrub_slots.release()
 
     def _scrub_pg_inner(self, pool_id: int, ps: int,
                         up: List[int]) -> None:

@@ -108,3 +108,55 @@ def test_compute_mode_matches_table_mode(monkeypatch):
     finally:
         monkeypatch.delenv("CEPH_TPU_STRAW2")
         importlib.reload(MS)
+
+
+def test_indep_cases_covered_and_leaf_type0_rejected():
+    """The indep lowering: eligible golden indep cases are bit-exact
+    (covered by the parametrized sweep), chooseleaf-indep-of-type-0 is
+    REFUSED (the reference leaks the last is_out-rejected device
+    through out2 there — a quirk the spec path does not reproduce),
+    and a randomized zero-weight differential pins the accepted shapes
+    against the scalar spec."""
+    import random
+
+    cmap, d = load("map_big10k")
+    # the golden corpus includes at least one eligible indep case
+    indep_cases = [c for c in d["cases"] if c["ruleno"] == 1]
+    assert indep_cases, "corpus lost its indep case"
+    analyze(cmap, 1, indep_cases[0]["numrep"])  # eligible
+
+    # randomized differential with rejections in play (zeroed weights)
+    from ceph_tpu.crush.mapper_ref import crush_do_rule
+
+    case = indep_cases[0]
+    rng = random.Random(99)
+    weights = list(case["weight"])
+    for _ in range(40):
+        weights[rng.randrange(len(weights))] = 0
+    m = SpeculativeMapper(cmap, k_tries=1)
+    import numpy as np
+
+    xs = np.arange(500, 564, dtype=np.uint32)
+    res, lens = m.map_batch(1, xs, case["numrep"],
+                            np.asarray(weights, np.uint32))
+    res, lens = np.asarray(res), np.asarray(lens)
+    for i, x in enumerate(xs):
+        want = crush_do_rule(cmap, 1, int(x), case["numrep"],
+                             list(weights))
+        assert list(res[i, :lens[i]]) == want, int(x)
+
+    # chooseleaf indep of type 0: must fall back to the general VM
+    from ceph_tpu.crush.map import Rule, RuleStep
+    from ceph_tpu.crush import constants as CC
+
+    cmap2, _ = load("map_flat12")
+    root_id = next(b.id for b in cmap2.buckets.values()
+                   if all(i >= 0 for i in b.items))
+    cmap2.rules[9] = Rule(steps=[
+        RuleStep(CC.CRUSH_RULE_TAKE, root_id, 0),
+        RuleStep(CC.CRUSH_RULE_CHOOSELEAF_INDEP, 4, 0),
+        RuleStep(CC.CRUSH_RULE_EMIT, 0, 0)])
+    # match on the ValueError base: the reload test earlier in this
+    # module swaps the Ineligible class identity in analyze's globals
+    with pytest.raises(ValueError, match="type 0"):
+        analyze(cmap2, 9, 4)
