@@ -302,8 +302,60 @@ def _stage_ec(plat, k=8, m=3, chunk=1 << 18, batch=4, iters=8,
           k=k, m=m, chunk=chunk, compile_s=round(compile_s, 2))
 
 
+def _stage_ec_profiles():
+    """BASELINE configs 2 and 4: jerasure RS k=4,m=2 encode/decode and
+    the LRC k=4,m=2,l=3 layered LOCAL repair (one lost chunk recovered
+    from its locality group, the point of the code)."""
+    import time as _t
+
+    import numpy as np
+
+    from ceph_tpu.ec.registry import factory
+
+    rng = np.random.default_rng(1)
+    size = 1 << 20
+
+    code = factory("jerasure", {"technique": "reed_sol_van",
+                                "k": "4", "m": "2", "w": "8"})
+    data = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+    n = code.get_chunk_count()
+    chunks = code.encode(range(n), data)
+    t0 = _t.perf_counter()
+    iters = 8
+    for _ in range(iters):
+        code.encode(range(n), data)
+    enc = size * iters / (_t.perf_counter() - t0) / 1e9
+    avail = {i: np.asarray(chunks[i]) for i in range(n) if i not in (0, 5)}
+    t0 = _t.perf_counter()
+    for _ in range(iters):
+        code.decode({0, 5}, dict(avail))
+    dec = size * iters / (_t.perf_counter() - t0) / 1e9
+    # these ride the plugin registry's portable bit-plane engine —
+    # the CPU fallback of the TPU Pallas path, not the native GF
+    # engine the headline EC stage uses
+    _emit(stage="ec_profile", profile="jerasure k=4,m=2",
+          engine="bitplane-cpu", encode_gbps=round(enc, 3),
+          decode_gbps=round(dec, 3))
+
+    lrc = factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    n = lrc.get_chunk_count()
+    chunks = lrc.encode(range(n), data)
+    lost = 1
+    need = lrc.minimum_to_decode({lost}, set(range(n)) - {lost})
+    avail = {i: np.asarray(chunks[i]) for i in need}
+    t0 = _t.perf_counter()
+    for _ in range(iters):
+        lrc.decode({lost}, dict(avail))
+    rep = size * iters / (_t.perf_counter() - t0) / 1e9
+    _emit(stage="ec_profile", profile="lrc k=4,m=2,l=3",
+          engine="bitplane-cpu",
+          local_repair_gbps=round(rep, 3),
+          repair_reads=len(need), total_chunks=n)
+
+
 def worker_ec_cpu():
     _stage_ec("cpu")
+    _try_stage("ec/profiles", _stage_ec_profiles)
 
 
 def worker_cluster():
@@ -520,15 +572,38 @@ def main():
             elapsed + EC_DEADLINE)
         ec_res = large or ec_res
         acc.kill("ec stages resolved")
+    prof_res = []
     if ec_res is None:
         ecw = Stream(_spawn("ec_cpu", "cpu"), "ec/cpu")
         ec_res = ecw.wait(is_ec, EC_DEADLINE)
+        # the profile stages run after the headline stage: give them
+        # their own window beyond whatever the headline consumed
+        ecw.wait(lambda r: sum(1 for x in ecw.results
+                               if x.get("stage") == "ec_profile") >= 2,
+                 (time.perf_counter() - ecw.t0) + 60)
+        prof_res = [r for r in ecw.results
+                    if r.get("stage") == "ec_profile"]
         ecw.kill("done")
+    else:
+        # the accelerator worker covered the headline EC stage; the
+        # BASELINE config 2/4 profiles are CPU-engine figures and must
+        # land either way
+        pw = Stream(_spawn("ec_profiles", "cpu"), "ec/profiles")
+        pw.wait(lambda r: sum(1 for x in pw.results
+                              if x.get("stage") == "ec_profile") >= 2,
+                90)
+        prof_res = [r for r in pw.results
+                    if r.get("stage") == "ec_profile"]
+        pw.kill("done")
     if ec_res is not None:
         print(f"# ec k=8,m=3: encode {ec_res['encode_gbps']:.2f} GB/s, "
               f"decode {ec_res['decode_gbps']:.2f} GB/s on "
               f"{ec_res['platform']} (compile {ec_res['compile_s']}s)",
               file=sys.stderr)
+    for r in prof_res:  # BASELINE configs 2 and 4
+        extras = {k: v for k, v in r.items()
+                  if k not in ("stage", "profile", "_t")}
+        print(f"# ec {r['profile']}: {extras}", file=sys.stderr)
     if acc is not None:
         acc.kill("bench done")
 
@@ -551,6 +626,8 @@ if __name__ == "__main__":
         {"staged": worker_staged,
          "crush_cpu": worker_crush_cpu,
          "ec_cpu": worker_ec_cpu,
+         "ec_profiles": lambda: _try_stage(
+             "ec/profiles", _stage_ec_profiles),
          "cluster": worker_cluster}[sys.argv[2]]()
     else:
         main()

@@ -357,7 +357,10 @@ def make_single_spec(cmap: CrushMap, ruleno: int, result_max: int,
         reproduces the reference's in-round collision ordering (slot j
         sees slots < j placed this round).  Positional: failed slots
         stay NONE."""
-        NR = min(plan.numrep, R)
+        # analyze() guarantees numrep <= result_max, so the segment
+        # is exactly [0, numrep)
+        assert plan.numrep <= R
+        NR = plan.numrep
         js = jnp.arange(plan.numrep, dtype=I32)
         out = jnp.full(R, UNDEF, I32)    # hosts
         out2 = jnp.full(R, UNDEF, I32)   # devices

@@ -880,9 +880,17 @@ class OSDService(MapFollower):
         self._last_scrub[key] = now
         # off the recovery thread: a slow member's 10s scrub RPC must
         # never delay re-peering of other PGs
-        threading.Thread(target=self._scrub_pg,
-                         args=(pool_id, ps, list(up)), daemon=True,
-                         name=f"osd{self.id}-scrub").start()
+        try:
+            threading.Thread(target=self._scrub_pg,
+                             args=(pool_id, ps, list(up)),
+                             daemon=True,
+                             name=f"osd{self.id}-scrub").start()
+        except RuntimeError:
+            # thread exhaustion: give the slot back or scrubbing would
+            # be disabled forever
+            self._scrub_slots.release()
+            self._last_scrub.pop(key, None)
+            raise
 
     def _scrub_pg(self, pool_id: int, ps: int,
                   up: List[int]) -> None:
