@@ -560,3 +560,31 @@ def test_scheduled_scrub_auto_repairs(tmp_path):
         assert cli.get(2, "ss-obj") == data
     finally:
         c.shutdown()
+
+
+def test_image_on_ec_pool(cluster):
+    """RBD-on-EC (the erasure-coded data-pool feature): a striped
+    image's RMW read/write, snapshot, and clone flows all ride the
+    primary-coordinated EC write path."""
+    from ceph_tpu.services.image import Image
+
+    cli = cluster.client("rbd-ec")
+    img = Image.create(cli, 2, "ec-img", 48 * 1024,
+                       object_size=8 * 1024)
+    img.write(0, b"EC-HEAD" * 100)
+    img.write(20_000, b"EC-TAIL" * 100)
+    assert img.read(0, 700) == (b"EC-HEAD" * 100)
+    assert img.read(20_000, 700) == (b"EC-TAIL" * 100)
+    # interior RMW within one piece
+    img.write(100, b"patch!")
+    got = img.read(95, 16)
+    assert got[5:11] == b"patch!"
+
+    img.snapshot("ecsnap")
+    img.protect_snap("ecsnap")
+    child = img.clone("ecsnap", "ec-img-child")
+    img.write(0, b"X" * 700)
+    assert child.read(100, 6) == b"patch!"  # COW isolation
+    child.flatten()
+    img.unprotect_snap("ecsnap")
+    assert child.read(20_000, 7) == b"EC-TAIL"
