@@ -575,10 +575,13 @@ def test_image_on_ec_pool(cluster):
     img.write(20_000, b"EC-TAIL" * 100)
     assert img.read(0, 700) == (b"EC-HEAD" * 100)
     assert img.read(20_000, 700) == (b"EC-TAIL" * 100)
-    # interior RMW within one piece
+    # interior RMW within one piece: the FULL window must match, so a
+    # merge that corrupts neighbors of the patched range is caught
     img.write(100, b"patch!")
-    got = img.read(95, 16)
-    assert got[5:11] == b"patch!"
+    base = b"EC-HEAD" * 100
+    want = bytearray(base)
+    want[100:106] = b"patch!"
+    assert img.read(95, 16) == bytes(want[95:111])
 
     img.snapshot("ecsnap")
     img.protect_snap("ecsnap")
