@@ -110,10 +110,16 @@ class MiniCluster:
         return None
 
     def wait_for_quorum(self, timeout: float = 15.0) -> Monitor:
+        """Wait for the STEADY-STATE leader: the lowest live rank, with
+        genesis committed.  (A higher rank can win a first round and
+        lead transiently until the lowest reachable rank's candidacy
+        deposes it — returning that one makes callers racy.)"""
         end = time.monotonic() + timeout
         while time.monotonic() < end:
             ldr = self.leader()
-            if ldr is not None and ldr.last_committed() > 0:
+            if ldr is not None and ldr.last_committed() > 0 and \
+                    (ldr.quorum is None or
+                     ldr is self.mons[min(self.mons)]):
                 return ldr
             time.sleep(0.1)
         raise TimeoutError("no monitor quorum")

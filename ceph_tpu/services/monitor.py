@@ -152,11 +152,16 @@ class Monitor:
         sync): replace live state from the full payload, store, push."""
         p = json.loads(entry["payload"])
         with self._lock:
+            if v != self._committed_epoch + 1:
+                # duplicate/stale delivery (racing catch-up paths must
+                # never roll the visible state backwards)
+                return
             self.map = OSDMap.from_dict(p["map"])
             self._osd_addrs = {int(k): tuple(a)
                                for k, a in p["osd_addrs"].items()}
             self.ec_profiles = dict(p["ec_profiles"])
-        self._store_committed(v, entry["payload"], entry.get("inc"))
+            self._store_committed(v, entry["payload"],
+                                  entry.get("inc"))
         self.pc.inc("epochs")
         self._push_maps()
 
@@ -300,6 +305,30 @@ class Monitor:
                 with open(os.path.join(
                         self.store_dir, f"osdmap.{v}.json"), "w") as f:
                     f.write(payload)
+
+    # Paxos durability (Paxos.cc persistent accepted_pn + uncommitted
+    # value via MonitorDBStore): the quorum layer writes its promise
+    # epoch and any staged-but-uncommitted entry here BEFORE acking, so
+    # restarts cannot lose a majority-staged entry or un-promise.
+    def store_quorum_state(self, state: Dict) -> None:
+        if not self.store_dir:
+            return
+        os.makedirs(self.store_dir, exist_ok=True)
+        tmp = os.path.join(self.store_dir, ".quorum.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(self.store_dir, "quorum.json"))
+
+    def load_quorum_state(self) -> Optional[Dict]:
+        if not self.store_dir:
+            return None
+        try:
+            return json.load(open(os.path.join(self.store_dir,
+                                               "quorum.json")))
+        except (OSError, ValueError):
+            return None
 
     def _restore_committed(self) -> None:
         """Roll live state back to the last committed entry (a failed

@@ -11,15 +11,27 @@ Election (ElectionLogic.cc's lowest-rank-wins, epoch-numbered):
   the lowest reachable rank collects a majority.  A monitor that sees a
   proposal from a higher rank starts its own candidacy; rank-staggered
   retry deadlines break ties.
-- the winner first SYNCS: collects last-committed versions (and any
-  accepted-but-uncommitted entry) from a majority, fetches whatever it
-  is missing, and re-proposes the highest uncommitted entry — the
-  Paxos collect/last phase (Paxos.cc:330-560) in single-decree form.
-  Majorities intersect, so any entry that ever reached a majority is
-  seen and preserved: epochs never fork.
+- the propose round IS the Paxos collect/last phase (Paxos.cc:330-560
+  in single-decree form): every ack carries the peer's last_committed
+  AND its staged-but-uncommitted entry, and victory requires a majority
+  of acks — so the promise majority intersects every accept majority
+  and any entry that ever reached a majority is seen and re-proposed.
+  Epochs never fork.  (Round-4 advisor finding: the old design gathered
+  uncommitted entries in a best-effort second round that could miss the
+  one holder; piggybacking on the propose acks closes that.)
 - leadership is kept alive with leases (Paxos.cc:1038 lease_*): the
-  leader broadcasts leases; a peon whose lease expires calls a new
-  election.
+  leader sends lease CALLS; peons ack.  The leader's own authority is
+  extended only while a majority of peons ack within the window — an
+  isolated leader demotes itself to ELECTING instead of serving stale
+  reads forever (round-4 advisor finding; matches the reference where
+  the leader's lease rides peon lease_ack).
+
+Durability (MonitorDBStore role, Paxos.cc persistent accepted_pn /
+uncommitted value): the election epoch (promise) and any staged entry
+are persisted through ``mon.store_quorum_state`` BEFORE the ack leaves
+the monitor, so leader-crash + staged-peon-restart cannot lose a
+majority-staged entry and a restarted peon cannot un-promise and ack a
+deposed leader's accept.
 
 Log replication (Paxos.cc begin/accept/commit, single-decree):
 - the leader sends ``mon_accept`` {epoch, version, entry} to peers; a
@@ -74,6 +86,7 @@ class Quorum:
         # accepted-but-uncommitted entry: {"v": int, "e": int,
         # "entry": {...}} — never applied until mon_commit
         self.uncommitted: Optional[Dict] = None
+        self._lease_fetching = False
         self._lock = threading.RLock()
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -82,13 +95,23 @@ class Quorum:
         m.register("mon_propose", self._h_propose)
         m.register("mon_victory", self._h_victory)
         m.register("mon_lease", self._h_lease)
-        m.register("mon_collect", self._h_collect)
         m.register("mon_fetch", self._h_fetch)
         m.register("mon_accept", self._h_accept)
         m.register("mon_commit", self._h_commit)
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> None:
+        # restore the promise + staged entry a crash may have left
+        # (Paxos.cc reads accepted_pn / uncommitted from the store)
+        loader = getattr(self.mon, "load_quorum_state", None)
+        if loader is not None:
+            st = loader() or {}
+            with self._lock:
+                self.election_epoch = max(self.election_epoch,
+                                          int(st.get(
+                                              "election_epoch", 0)))
+                if st.get("uncommitted"):
+                    self.uncommitted = st["uncommitted"]
         self._running = True
         self._thread = threading.Thread(target=self._tick_loop,
                                         daemon=True,
@@ -115,6 +138,15 @@ class Quorum:
         return [(r, a) for r, a in enumerate(self.addrs)
                 if r != self.rank]
 
+    def _persist_locked(self) -> None:
+        """Durably record (election_epoch, uncommitted) — called with
+        the lock held, BEFORE the ack that makes the state externally
+        visible.  No-op for storeless monitors (tests)."""
+        saver = getattr(self.mon, "store_quorum_state", None)
+        if saver is not None:
+            saver({"election_epoch": self.election_epoch,
+                   "uncommitted": self.uncommitted})
+
     # -- the ticker -------------------------------------------------------
     def _tick_loop(self) -> None:
         # rank-staggered first election so rank 0 usually wins round 1
@@ -132,11 +164,28 @@ class Quorum:
             state = self.state
             lease_out = now > self.lease_expiry
             due = now >= self._next_election
-        if state == LEADER:
+            # a live monitor that OUTRANKS its leader stands for
+            # election (the reference re-elects when a lower rank
+            # joins, ElectionLogic's lowest-rank-wins is a standing
+            # invariant, not a startup accident)
+            outranked = (state == PEON
+                         and self.leader_rank is not None
+                         and self.leader_rank > self.rank)
+        if state == LEADER and lease_out:
+            # a majority of peons stopped acking leases: this leader is
+            # partitioned/isolated and must stop serving leader-only
+            # duties instead of running on a stale map forever
+            self.mon.log.dout(1, f"mon.{self.rank}: leader lease "
+                                 f"lapsed (no peon-ack majority), "
+                                 f"demoting")
+            self.abdicate()
+        elif state == LEADER:
             self._send_leases()
         elif state == PEON and lease_out:
             self.mon.log.dout(1, f"mon.{self.rank}: lease expired, "
                                  f"calling election")
+            self._start_election()
+        elif outranked and due:
             self._start_election()
         elif state in (PROBING, ELECTING) and due:
             self._start_election()
@@ -156,6 +205,11 @@ class Quorum:
         acks = 1
         infos = [{"rank": self.rank,
                   "last_committed": self.mon.last_committed()}]
+        uncommitted = []
+        with self._lock:
+            self._persist_locked()  # durable promise for our own round
+            if self.uncommitted is not None:
+                uncommitted.append(self.uncommitted)
         for r, addr in self._others():
             try:
                 rep = self.mon.msgr.call(
@@ -169,12 +223,17 @@ class Quorum:
                 infos.append({"rank": r,
                               "last_committed":
                                   rep.get("last_committed", 0)})
+                if rep.get("uncommitted"):
+                    uncommitted.append(rep["uncommitted"])
         with self._lock:
             if self.election_epoch != e or self.state != ELECTING:
                 return  # a newer round superseded this one
             if acks < self.majority:
                 return  # retry at the staggered deadline
-        self._win(e, infos)
+        # the ack majority IS the collect majority: every ack carried
+        # last_committed + any staged entry, so the intersection
+        # argument holds without a second best-effort round
+        self._win(e, infos, uncommitted)
 
     def _h_propose(self, msg: Dict) -> Dict:
         e, r = int(msg["e"]), int(msg["rank"])
@@ -188,23 +247,26 @@ class Quorum:
                     self.state = ELECTING
                     self.leader_rank = None
             ack = r < self.rank
-            if not ack:
+            if ack:
+                # the promise must be durable before it leaves: a
+                # restarted peon that forgot this epoch could ack a
+                # deposed leader's accept at the same version
+                self._persist_locked()
+            else:
                 # I outrank the proposer and I'm alive: stand myself
                 self._next_election = time.monotonic()
             return {"ack": ack, "epoch": self.election_epoch,
-                    "last_committed": self.mon.last_committed()}
+                    "last_committed": self.mon.last_committed(),
+                    "uncommitted": self.uncommitted}
 
-    def _win(self, e: int, infos: List[Dict]) -> None:
+    def _win(self, e: int, infos: List[Dict],
+             uncommitted: List[Dict]) -> None:
         """Sync to the newest majority state, then declare victory.
 
-        ``infos`` (rank, last_committed) comes from the majority of
-        propose acks, so the newest committed version is known even if
-        every explicit collect call below fails; the collect round
-        additionally gathers staged-but-uncommitted entries."""
-        uncommitted = []
-        with self._lock:
-            if self.uncommitted is not None:
-                uncommitted.append(self.uncommitted)
+        ``infos`` (rank, last_committed) and ``uncommitted`` come from
+        the MAJORITY of propose acks — the durable collect phase — so
+        the newest committed version and every possibly-majority-staged
+        entry are in hand before leadership is declared."""
         best_lc = self.mon.last_committed()
         best_peer = None
         for row in infos:
@@ -212,18 +274,6 @@ class Quorum:
                     int(row["last_committed"]) > best_lc:
                 best_lc = int(row["last_committed"])
                 best_peer = self.addrs[row["rank"]]
-        for r, addr in self._others():
-            try:
-                rep = self.mon.msgr.call(addr,
-                                         {"type": "mon_collect", "e": e},
-                                         timeout=self.call_timeout)
-            except (OSError, TimeoutError):
-                continue
-            lc = int(rep.get("last_committed", 0))
-            if lc > best_lc:
-                best_lc, best_peer = lc, addr
-            if rep.get("uncommitted"):
-                uncommitted.append(rep["uncommitted"])
         if best_peer is not None:
             self._fetch_from(best_peer, best_lc)
 
@@ -281,39 +331,74 @@ class Quorum:
             self.state = PEON if leader != self.rank else LEADER
             self.leader_rank = leader
             self.lease_expiry = time.monotonic() + self.lease * 3
+            self._persist_locked()
         return {"ok": True,
                 "last_committed": self.mon.last_committed()}
 
     # -- leases -----------------------------------------------------------
     def _send_leases(self) -> None:
+        """Lease round as request/ack (Paxos.cc lease / lease_ack): the
+        leader's OWN lease is extended only when a majority of members
+        (self included) acked this round — an isolated leader stops
+        being one at its next lease expiry instead of ticking itself
+        alive forever."""
         with self._lock:
             e = self.election_epoch
             if self.state != LEADER:
                 return
-            # the leader's own lease: refreshed by virtue of being able
-            # to tick (its authority is checked at every commit anyway)
-            self.lease_expiry = time.monotonic() + self.lease * 3
         msg = {"type": "mon_lease", "e": e, "leader": self.rank,
                "last_committed": self.mon.last_committed()}
+        acks = 1
+        timeout = min(self.call_timeout, max(self.lease / 2, 0.2))
         for r, addr in self._others():
-            self.mon.msgr.send(addr, msg)
+            try:
+                rep = self.mon.msgr.call(addr, msg, timeout=timeout)
+            except (OSError, TimeoutError):
+                continue
+            if rep and rep.get("ok"):
+                acks += 1
+        if acks >= self.majority:
+            with self._lock:
+                if self.state == LEADER and self.election_epoch == e:
+                    self.lease_expiry = time.monotonic() + \
+                        self.lease * 3
 
-    def _h_lease(self, msg: Dict) -> None:
+    def _h_lease(self, msg: Dict) -> Dict:
         e, leader = int(msg["e"]), int(msg["leader"])
         with self._lock:
             if e < self.election_epoch:
-                return None
+                return {"ok": False, "epoch": self.election_epoch}
             if e > self.election_epoch or self.leader_rank != leader:
                 self.election_epoch = e
                 self.leader_rank = leader
                 self.state = PEON if leader != self.rank else LEADER
+                self._persist_locked()
             self.lease_expiry = time.monotonic() + self.lease * 3
             leader_addr = self.addrs[leader]
-        # catch up on committed entries we missed (dropped mon_commit)
+        # catch up on committed entries we missed (dropped mon_commit) —
+        # off-thread so a long fetch cannot stall the leader's lease
+        # round into a false demotion.  Single-flight: leases arrive
+        # every lease/3 and concurrent fetch threads would race
+        # check-then-apply in apply_committed.
         lc = int(msg.get("last_committed", 0))
         if lc > self.mon.last_committed():
-            self._fetch_from(leader_addr, lc)
-        return None
+            with self._lock:
+                spawn = not self._lease_fetching
+                self._lease_fetching = True
+            if spawn:
+                threading.Thread(
+                    target=self._lease_fetch, args=(leader_addr, lc),
+                    daemon=True,
+                    name=f"mon{self.rank}-leasefetch").start()
+        return {"ok": True,
+                "last_committed": self.mon.last_committed()}
+
+    def _lease_fetch(self, addr: Addr, to_v: int) -> None:
+        try:
+            self._fetch_from(addr, to_v)
+        finally:
+            with self._lock:
+                self._lease_fetching = False
 
     # -- replication ------------------------------------------------------
     def replicate(self, v: int, entry: Dict) -> bool:
@@ -353,6 +438,10 @@ class Quorum:
                 return {"ack": False,
                         "last_committed": self.mon.last_committed()}
             self.uncommitted = {"v": v, "e": e, "entry": msg["entry"]}
+            # the stage must hit the store before the ack: with it, a
+            # leader crash + staged-peon restart still leaves the entry
+            # recoverable by the next election's collect majority
+            self._persist_locked()
             return {"ack": True}
 
     def _h_commit(self, msg: Dict) -> None:
@@ -365,13 +454,14 @@ class Quorum:
             entry = u["entry"]
         if v == self.mon.last_committed() + 1:
             self.mon.apply_committed(v, entry)
-        return None
-
-    def _h_collect(self, msg: Dict) -> Dict:
+        # durably clear the stage only AFTER the entry itself is
+        # durable: clearing first opens a crash window where a
+        # majority-staged entry vanishes from every surviving store.
+        # The reverse order is safe — a stale staged copy of an
+        # already-applied entry is filtered by the v == lc+1 pick.
         with self._lock:
-            u = self.uncommitted
-        return {"last_committed": self.mon.last_committed(),
-                "uncommitted": u}
+            self._persist_locked()
+        return None
 
     def _h_fetch(self, msg: Dict) -> Dict:
         frm, to = int(msg["from_v"]), int(msg["to_v"])

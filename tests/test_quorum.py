@@ -139,6 +139,56 @@ def test_minority_partition_commits_nothing(cluster):
     assert m0.last_committed() <= base + 1
 
 
+def test_staged_entry_survives_leader_crash_and_peon_restart(tmp_path):
+    """Paxos durability (Paxos.cc:330-560 persistent accepted_pn +
+    uncommitted value via MonitorDBStore): stage an entry on one peon
+    as if the leader crashed mid-replicate, kill the leader AND restart
+    the staged peon, and require the next election to recover and
+    commit that exact entry — never a different one at that version."""
+    import json
+
+    c = MiniCluster(n_osds=2, hosts=2, config=fast_conf(), n_mons=3,
+                    data_dir=str(tmp_path)).start()
+    try:
+        ldr = c.wait_for_quorum()
+        assert ldr is c.mons[0]
+        lc = ldr.last_committed()
+        m2 = c.mons[2]
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and m2.last_committed() < lc:
+            time.sleep(0.05)
+        assert m2.last_committed() == lc
+
+        # hand-deliver an accept to mon.2 only — the moment after a
+        # real leader got its first (and only) accept ack and died
+        p = ldr.get_epoch_payload(lc)
+        p["epoch"] = lc + 1
+        p["map"]["epoch"] = lc + 1
+        entry = {"payload": json.dumps(p), "inc": None}
+        e = m2.quorum.election_epoch
+        rep = m2.msgr.call(m2.addr, {"type": "mon_accept", "e": e,
+                                     "v": lc + 1, "entry": entry},
+                           timeout=5)
+        assert rep.get("ack")
+
+        c.kill_mon(0)        # leader dies without ever committing
+        c.kill_mon(2)        # the one staged holder crashes too...
+        c.revive_mon(2)      # ...and restarts from its store
+        new = c.wait_for_quorum()
+        assert new is c.mons[1]  # the new leader never saw the entry
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                new.last_committed() < lc + 1:
+            time.sleep(0.1)
+        # the restarted peon's persisted stage rode its propose ack
+        # into the new leader's collect majority and was re-proposed
+        assert new.last_committed() >= lc + 1
+        assert json.loads(new._epochs[lc + 1]) == p
+        assert_no_fork(c)
+    finally:
+        c.shutdown()
+
+
 def test_quorum_with_auth_keyring(tmp_path):
     """Signed clusters: election, replication, forwarding, and the
     data path all ride HMAC-authenticated frames (mon↔mon quorum

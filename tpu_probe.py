@@ -1,0 +1,297 @@
+"""Opportunistic TPU probe — land an accelerator number whenever the
+flaky TPU tunnel happens to be up.
+
+The driver's bench window has missed the tunnel four rounds running
+(BENCH_r01..r04: "backend never initialized").  This probe is the
+complement: run it repeatedly across the whole round (``--loop``), and
+the moment a quick ``jax.devices()`` subprocess resolves to a real
+accelerator, run the measurement stages (speculative + general CRUSH
+mapper on the 10k-OSD map with a k_tries x straw2-mode sweep, and the
+RS/Pallas EC kernels) and append the timestamped results to
+``TPU_PROBE.json`` — committing that artifact immediately so the
+evidence survives even if the round ends mid-flight.
+
+Failed attempts are recorded too (timestamped), so "the tunnel never
+rose" is itself provable.
+
+Usage:
+  python tpu_probe.py              one attempt (quick probe -> stages)
+  python tpu_probe.py --loop [s]   probe forever, sleeping s (def 600)
+  python tpu_probe.py --worker X   internal subprocess entry
+
+Matches the reference harnesses: crushtool --test hot loop
+(src/crush/CrushTester.cc:432-680) and the EC benchmark
+(src/test/erasure-code/ceph_erasure_code_benchmark.cc:176-315).
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent
+ARTIFACT = REPO / "TPU_PROBE.json"
+RESULT_TAG = "BENCH_RESULT "
+
+QUICK_TIMEOUT = float(os.environ.get("CEPH_TPU_PROBE_QUICK_TIMEOUT", 90))
+STAGE_DEADLINE = float(os.environ.get("CEPH_TPU_PROBE_STAGE_DEADLINE", 900))
+
+
+def _now():
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def _emit(**kw):
+    print(RESULT_TAG + json.dumps(kw), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# workers (subprocess side — the only code that imports jax)
+# ---------------------------------------------------------------------------
+
+def worker_quick():
+    """Resolve the backend and print one line.  Hangs here (killed by
+    the parent's timeout) are the tunnel being down."""
+    import jax
+
+    d = jax.devices()
+    print(json.dumps({"platform": d[0].platform,
+                      "n_devices": len(d)}), flush=True)
+
+
+def _stage_spec(bench, name, plat, k_tries, mode, batch, iters):
+    """One speculative-mapper measurement at a given (k_tries, straw2
+    mode) point — the sweep the VERDICT asked for (weak #1/#8)."""
+    import jax
+    import jax.numpy as jnp
+
+    os.environ["CEPH_TPU_STRAW2"] = mode
+    from ceph_tpu.crush.mapper_spec import build_spec_rule_fn
+
+    cmap, case = bench._load_case(name)
+    t0 = time.perf_counter()
+    fn, static, arrays = build_spec_rule_fn(
+        cmap, case["ruleno"], case["numrep"], k_tries=k_tries)
+    A = jax.tree_util.tree_map(jnp.asarray, arrays)
+    weight = jnp.asarray(case["weight_np"])
+    xs = jnp.arange(batch, dtype=jnp.uint32)
+    res, lens = fn(A, weight, xs)
+    res.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    bench._golden_check(case, res, lens,
+                        f"{plat}/{name}/spec-k{k_tries}-{mode}")
+    rate, dt = bench._measure_crush(fn, A, weight, batch, iters)
+    _emit(stage="crush", map=name, rate=rate, platform=plat,
+          engine="xla-spec", k_tries=k_tries, straw2=mode,
+          compile_s=round(compile_s, 2), measure_s=round(dt, 3),
+          batch=batch, iters=iters)
+    return rate
+
+
+def _stage_pallas_ec(plat, k=8, m=3, chunk=1 << 20, batch=4, iters=8):
+    """The fused GF(2) bit-plane matmul Pallas kernel, measured raw —
+    the TPU analogue of ISA-L's ec_encode_data hot loop."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ceph_tpu.ec import gf
+    from ceph_tpu.ec.pallas_kernels import fused_gf2_matmul_w8
+
+    gfm = gf.rs_vandermonde_matrix(k, m)[k:]     # parity rows only
+    bm = jnp.asarray(gf.expand_bitmatrix(gfm))
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(
+        rng.integers(0, 256, (k, batch * chunk), dtype=np.uint8))
+    t0 = time.perf_counter()
+    out = fused_gf2_matmul_w8(bm, data)
+    out.block_until_ready()
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fused_gf2_matmul_w8(bm, data)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    _emit(stage="ec_pallas", platform=plat, k=k, m=m,
+          encode_gbps=round(k * batch * chunk * iters / dt / 1e9, 3),
+          chunk=chunk, compile_s=round(compile_s, 2))
+
+
+def worker_stages():
+    """Full measurement sweep, cheapest-first so every extra second of
+    tunnel uptime converts to at least one more landed number."""
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    t_boot = time.perf_counter()
+    import jax
+
+    bench._enable_compile_cache()
+    plat = jax.devices()[0].platform
+    _emit(stage="init", platform=plat,
+          init_s=round(time.perf_counter() - t_boot, 1),
+          n_devices=jax.device_count())
+    on = plat != "cpu"
+    batch = (1 << 16) if on else (1 << 13)
+    iters = 8 if on else 2
+    # k_tries x straw2-mode sweep, expected-value order (table first:
+    # the LN16-table reciprocal-mulhi key built for TPU, never measured
+    # there; k=1 compiles fastest)
+    for k_tries, mode in ((1, "table"), (4, "table"), (8, "table"),
+                          (1, "compute"), (8, "compute"),
+                          (16, "table"), (4, "compute"),
+                          (16, "compute")):
+        bench._try_stage(
+            f"spec/big10k/k{k_tries}/{mode}", _stage_spec, bench,
+            "map_big10k", plat, k_tries, mode, batch, iters)
+    bench._try_stage("gen/big10k", bench._stage_crush, "map_big10k",
+                     plat, batch=(1 << 17) if on else (1 << 13),
+                     iters=8 if on else 2)
+    bench._try_stage("ec_pallas", _stage_pallas_ec, plat)
+    bench._try_stage("ec/large", bench._stage_ec, plat,
+                     chunk=1 << 20, batch=4, iters=8, tag="large")
+
+
+# ---------------------------------------------------------------------------
+# parent orchestration (never imports jax)
+# ---------------------------------------------------------------------------
+
+def _load_artifact():
+    if ARTIFACT.exists():
+        try:
+            return json.load(open(ARTIFACT))
+        except Exception:
+            pass
+    return {"attempts": []}
+
+
+def _save_artifact(doc):
+    ARTIFACT.write_text(json.dumps(doc, indent=1) + "\n")
+
+
+def _commit_artifact(msg):
+    """Commit ONLY the artifact (git commit -o) so a background probe
+    can never sweep up unrelated in-progress work."""
+    try:
+        subprocess.run(["git", "add", "--intent-to-add",
+                        str(ARTIFACT)], cwd=str(REPO), check=False,
+                       capture_output=True)
+        subprocess.run(["git", "commit", "-o", str(ARTIFACT),
+                        "-m", msg], cwd=str(REPO), check=False,
+                       capture_output=True, timeout=60)
+    except Exception as e:
+        print(f"# commit failed: {e}", file=sys.stderr)
+
+
+def attempt():
+    """One probe attempt.  Returns True if an accelerator number landed."""
+    doc = _load_artifact()
+    rec = {"ts": _now(), "quick_timeout_s": QUICK_TIMEOUT}
+    env = dict(os.environ)
+    proc = subprocess.Popen(
+        [sys.executable, str(REPO / "tpu_probe.py"), "--worker",
+         "quick"], env=env, stdout=subprocess.PIPE, stderr=None,
+        text=True, cwd=str(REPO))
+    t0 = time.perf_counter()
+    try:
+        out, _ = proc.communicate(timeout=QUICK_TIMEOUT)
+        rec["quick_s"] = round(time.perf_counter() - t0, 1)
+        info = json.loads(out.strip().splitlines()[-1])
+        rec.update(info)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        rec["outcome"] = "timeout"
+        rec["detail"] = f"jax.devices() hung {QUICK_TIMEOUT:.0f}s " \
+            "(tunnel down)"
+        doc["attempts"].append(rec)
+        _save_artifact(doc)
+        print(f"# probe {rec['ts']}: tunnel down (quick probe hung)",
+              file=sys.stderr)
+        return False
+    except Exception as e:
+        proc.kill()
+        rec["outcome"] = "error"
+        rec["detail"] = repr(e)
+        doc["attempts"].append(rec)
+        _save_artifact(doc)
+        return False
+
+    if rec.get("platform") in (None, "cpu"):
+        rec["outcome"] = "cpu_only"
+        doc["attempts"].append(rec)
+        _save_artifact(doc)
+        print(f"# probe {rec['ts']}: resolved to cpu (no accelerator)",
+              file=sys.stderr)
+        return False
+
+    # tunnel is UP — run the measurement stages, streaming results so a
+    # mid-flight tunnel drop still keeps everything landed so far
+    print(f"# probe {rec['ts']}: {rec['platform']} x"
+          f"{rec.get('n_devices')} UP — running stages",
+          file=sys.stderr)
+    rec["outcome"] = "up"
+    rec["results"] = []
+    proc = subprocess.Popen(
+        [sys.executable, str(REPO / "tpu_probe.py"), "--worker",
+         "stages"], env=env, stdout=subprocess.PIPE, stderr=None,
+        text=True, cwd=str(REPO))
+    end = time.monotonic() + STAGE_DEADLINE
+    try:
+        for line in proc.stdout:
+            if line.startswith(RESULT_TAG):
+                r = json.loads(line[len(RESULT_TAG):])
+                r["ts"] = _now()
+                rec["results"].append(r)
+                print(f"# stage landed: {r}", file=sys.stderr)
+                _save_artifact(doc if rec in doc["attempts"] else
+                               _push(doc, rec))
+            if time.monotonic() > end:
+                proc.kill()
+                rec["detail"] = "stage deadline hit"
+                break
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+    if rec not in doc["attempts"]:
+        doc["attempts"].append(rec)
+    crush = [r for r in rec["results"] if r.get("stage") == "crush"]
+    if crush:
+        best = max(crush, key=lambda r: r.get("rate", 0.0))
+        doc["best"] = best
+        _save_artifact(doc)
+        _commit_artifact(
+            f"TPU probe: {best['rate']:.0f} mappings/s on "
+            f"{best['platform']} ({best.get('engine')})")
+        return True
+    _save_artifact(doc)
+    _commit_artifact("TPU probe: tunnel up, stage results recorded")
+    return bool(rec["results"])
+
+
+def _push(doc, rec):
+    doc["attempts"].append(rec)
+    return doc
+
+
+def main():
+    args = sys.argv[1:]
+    if args[:1] == ["--worker"]:
+        from ceph_tpu.utils.platform import apply_platform_env
+
+        apply_platform_env()
+        {"quick": worker_quick, "stages": worker_stages}[args[1]]()
+        return
+    if args[:1] == ["--loop"]:
+        interval = float(args[1]) if len(args) > 1 else 600.0
+        while True:
+            ok = attempt()
+            time.sleep(interval if not ok else interval * 3)
+        return
+    sys.exit(0 if attempt() else 1)
+
+
+if __name__ == "__main__":
+    main()
