@@ -126,6 +126,16 @@ def default_osd_queue() -> MClockQueue:
     })
 
 
+class Requeue(Exception):
+    """Raised by a job to be put back at the tail of its class queue —
+    the bounded-resource-wait escape (a shard op whose PG lock is held
+    by a long peering pass).  The WORKER moves on to other ops instead
+    of blocking, so two stuck writes can no longer occupy the whole
+    pool and starve every other PG's ops (the reference's ShardedOpWQ
+    requeues ops that cannot take their PG lock the same way); the
+    SUBMITTER keeps blocking on its original submit()."""
+
+
 class OpScheduler:
     """Threaded front for MClockQueue — the OpScheduler/shard-worker
     seam (src/osd/scheduler/OpScheduler.h + OSD::ShardedOpWQ role):
@@ -157,13 +167,19 @@ class OpScheduler:
         done = threading.Event()
         box: list = [None, None]  # result, exception
 
-        def job():
+        def job(final: bool = False):
             try:
                 box[0] = fn()
+            except Requeue:
+                if not final:
+                    return True  # scheduler re-enqueues
+                box[1] = RuntimeError(
+                    "op abandoned at scheduler shutdown (resource "
+                    "still busy)")
             except BaseException as e:  # propagated to the submitter
                 box[1] = e
-            finally:
-                done.set()
+            done.set()
+            return None
 
         with self._cv:
             if not self._running:
@@ -193,7 +209,15 @@ class OpScheduler:
                     return
                 cls, job = got
                 self.served[cls] += 1
-            job()
+            if job():
+                # bounded wait failed: back of the class queue (the
+                # job itself paces via its own wait timeout)
+                with self._cv:
+                    if self._running:
+                        self.q.enqueue(cls, job, _time.monotonic())
+                        self._cv.notify()
+                    else:
+                        job(final=True)
 
     def depths(self) -> Dict[str, int]:
         with self._cv:
@@ -213,4 +237,4 @@ class OpScheduler:
                     break
                 leftovers.append(got[1])
         for job in leftovers:
-            job()
+            job(final=True)

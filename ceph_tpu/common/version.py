@@ -19,3 +19,13 @@ def make_version(epoch: int) -> str:
 
 
 NULL_VERSION = "0" * 12 + "." + "0" * 20
+
+
+def bump(version: str) -> str:
+    """The smallest version strictly greater than ``version`` (same
+    epoch field, timestamp+1).  Lets a writer whose wall clock lags a
+    stored version re-stamp PAST it instead of silently losing
+    last-writer-wins — the read-your-writes repair for client clock
+    skew."""
+    epoch_s, ts_s = version.split(".")
+    return f"{epoch_s}.{int(ts_s) + 1:020d}"

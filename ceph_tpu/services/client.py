@@ -18,7 +18,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from ..common.version import make_version
+from ..common.version import bump, make_version
 from ..msg.messenger import Addr, Messenger
 from ..osdmap.osdmap import OSDMap, POOL_TYPE_ERASURE
 from ..ec.registry import profile_factory
@@ -28,6 +28,16 @@ class ObjectNotFound(KeyError):
     """Every reachable shard holder answered ENOENT — the object does
     not exist (distinct from transient unreachability, which raises
     TimeoutError/OSError and is retried)."""
+
+
+class _Superseded(OSError):
+    """A shard holder discarded our write because it already stores a
+    newer version — our wall clock lags.  Carries the stored version
+    so the retry can stamp past it (read-your-writes repair)."""
+
+    def __init__(self, cur: str):
+        super().__init__(f"write superseded by stored version {cur}")
+        self.cur = cur
 
 
 def object_to_ps(oid: str) -> int:
@@ -99,9 +109,16 @@ class Client(MapFollower):
     def put(self, pool_id: int, oid: str, data: bytes,
             retries: int = 3) -> None:
         # one version for every shard of this logical write: replicas
-        # agree on recency at peering time (the eversion_t role)
-        v = make_version(self.epoch)
+        # agree on recency at peering time (the eversion_t role).
+        # Stamped per attempt: a `superseded` reply means our clock
+        # lags the stored version, so the retry re-stamps PAST it
+        # (version floor) instead of being silently discarded while
+        # acked ok — that would break read-your-writes.
+        floor = None
         for attempt in range(retries):
+            v = make_version(self.epoch)
+            if floor is not None and v <= floor:
+                v = bump(floor)
             try:
                 # inside the retry loop: a freshly-created pool may be
                 # a map epoch away (a peon served the refresh before
@@ -137,6 +154,10 @@ class Client(MapFollower):
                         raise OSError(
                             f"ec put via osd.{prim}: {got}")
                 return
+            except _Superseded as s:
+                if attempt + 1 == retries:
+                    raise
+                floor = max(floor or "", s.cur)
             except (TimeoutError, OSError, KeyError):
                 if attempt + 1 == retries:
                     raise
@@ -153,6 +174,10 @@ class Client(MapFollower):
                              timeout=10)
         if not got.get("ok"):
             raise OSError(f"shard_write to osd.{osd}: {got}")
+        if got.get("superseded"):
+            # the OSD kept its newer version; acking this as success
+            # would break read-your-writes for a lagging clock
+            raise _Superseded(got.get("cur") or "")
 
     def get(self, pool_id: int, oid: str, retries: int = 3,
             notfound_retries: int = 2) -> bytes:
@@ -389,13 +414,21 @@ class Client(MapFollower):
         peering will roll it back) must not shadow the last acked
         state."""
         k = code.get_data_chunk_count()
+        m = code.get_chunk_count() - k
         by_ver: Dict[str, Dict[int, np.ndarray]] = {}
         sizes: Dict[str, int] = {}
         enoent = 0
         reachable = 0
         for pos, osd in enumerate(up):
             done = any(len(c) >= k for c in by_ver.values())
-            if done and max(by_ver) == max(
+            # Early exit is only sound when m < k: an acked write
+            # covers >= k positions, so at most m stale shards exist
+            # and k stale chunks cannot assemble without surfacing at
+            # least one newer shard (which un-satisfies the newest-
+            # seen-is-decodable condition).  With m >= k a reader
+            # could decode k stale shards before probing any position
+            # the newest acked write landed on — probe them all.
+            if done and m < k and max(by_ver) == max(
                     (v for v, c in by_ver.items() if len(c) >= k)):
                 break  # the newest version seen is already decodable
             try:

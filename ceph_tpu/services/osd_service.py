@@ -31,6 +31,7 @@ clears it once clean — the serving-continuity half of peering.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
@@ -44,7 +45,8 @@ from ..os.objectstore import Transaction
 from ..osdmap.osdmap import OSDMap, POOL_TYPE_ERASURE
 
 
-from ..common.version import NULL_VERSION, make_version
+from ..common.op_queue import Requeue
+from ..common.version import NULL_VERSION, bump, make_version
 
 
 def pg_cid(pool_id: int, ps: int) -> str:
@@ -247,8 +249,9 @@ class OSDService(MapFollower):
                           f"{msg.get('frm')}") as op:
             # per-PG lock, not the global one: a WALStore fsync per
             # write must never serialize the whole daemon or stall map
-            # handling behind the write stream
-            with self._pg_lock(msg["pool"], msg["ps"]):
+            # handling behind the write stream.  Bounded: a miss
+            # requeues instead of pinning the scheduler worker.
+            with self._pg_lock_bounded(msg["pool"], msg["ps"]):
                 # a newer version (a divergent-history reconciliation
                 # or a racing later write) must never be clobbered by
                 # an older one arriving late
@@ -259,7 +262,11 @@ class OSDService(MapFollower):
                     if not msg.get("force") or (
                             msg.get("expect") is not None
                             and cur.decode() != msg["expect"]):
+                        # `cur` lets the writer re-stamp past the
+                        # stored version (clock-skew repair) instead
+                        # of mistaking the discard for success
                         return {"ok": True, "superseded": True,
+                                "cur": cur.decode(),
                                 "epoch": self.epoch}
                     # authoritative rollback of a torn (never-acked)
                     # higher-version shard: fall through and overwrite
@@ -360,6 +367,21 @@ class OSDService(MapFollower):
         return {"ok": True, "epoch": self.epoch}
 
     # -- EC partial-stripe overwrite (primary-coordinated RMW) ---------
+    @contextlib.contextmanager
+    def _pg_lock_bounded(self, pool_id: int, ps: int,
+                         timeout: float = 0.25):
+        """PG lock with a bounded wait for SCHEDULER-run ops: a miss
+        raises Requeue, freeing the worker for other PGs while peering
+        holds this one (ShardedOpWQ's requeue-on-lock-miss behavior —
+        two writes to a peering PG must not starve the whole op pool)."""
+        lk = self._pg_lock(pool_id, ps)
+        if not lk.acquire(timeout=timeout):
+            raise Requeue()
+        try:
+            yield
+        finally:
+            lk.release()
+
     def _pg_lock(self, pool_id: int, ps: int) -> threading.RLock:
         with self._pg_locks_guard:
             return self._pg_locks.setdefault((pool_id, ps),
@@ -417,18 +439,50 @@ class OSDService(MapFollower):
                 buf[:len(base)] = base
                 buf[offset:offset + len(data)] = data
             v = msg.get("v") or make_version(self.epoch)
+            # PRIMARY-side version floor: the stamped version must
+            # exceed what is stored, or a client with a lagging clock
+            # writes a version that loses last-writer-wins to data it
+            # itself read (the reference stamps eversion_t at the
+            # primary for the same reason).  The primary's own shard
+            # is the floor source — it holds the newest acked version
+            # whenever it is not itself degraded.
+            mypos = next((p for p, o in enumerate(up)
+                          if o == self.id), None)
+            if mypos is not None:
+                cid = pg_cid(pool_id, ps)
+                curb = self.store.getattr(
+                    cid, f"{oid}.s{mypos}", "v") \
+                    if self.store.collection_exists(cid) else None
+                if curb is not None and v <= curb.decode():
+                    v = bump(curb.decode())
             n = code.get_chunk_count()
             k = code.get_data_chunk_count()
             chunks = code.encode(range(n), bytes(buf))
-            landed = 0
-            for pos, osd in enumerate(up):
-                if not (osd == self.id or self._alive(osd)):
-                    continue  # peering recovers it at version v
-                if self._push_shard(
-                        pool_id, ps, osd, oid, pos,
-                        np.asarray(chunks[pos], np.uint8).tobytes(),
-                        size, v, qos="client"):
-                    landed += 1
+            payloads = [np.asarray(chunks[p], np.uint8).tobytes()
+                        for p in range(n)]
+            # distribute; a `superseded` reply means some holder has a
+            # NEWER stored version our floor probe missed (our own
+            # shard degraded) — counting it as landed would ack a
+            # write that readers never see.  Re-stamp past the
+            # reported version and redistribute.
+            for _restamp in range(3):
+                landed, newest = 0, None
+                for pos, osd in enumerate(up):
+                    if not (osd == self.id or self._alive(osd)):
+                        continue  # peering recovers it at version v
+                    rep = self._push_shard(pool_id, ps, osd, oid, pos,
+                                           payloads[pos], size, v,
+                                           qos="client")
+                    if rep is None or not rep.get("ok"):
+                        continue
+                    if rep.get("superseded"):
+                        newest = max(newest or "",
+                                     rep.get("cur") or "")
+                    else:
+                        landed += 1
+                if newest is None:
+                    break
+                v = bump(newest)
             if landed < k:
                 # an acked write must be durable: fewer than k shards
                 # at v would be acknowledged-but-unreadable data loss
@@ -754,7 +808,7 @@ class OSDService(MapFollower):
         cid = pg_cid(msg["pool"], msg["ps"])
         inconsistent: List[str] = []
         digests: Dict[str, int] = {}
-        with self._pg_lock(int(msg["pool"]), int(msg["ps"])):
+        with self._pg_lock_bounded(int(msg["pool"]), int(msg["ps"])):
             if self.store.collection_exists(cid):
                 for name in self.store.list_objects(cid):
                     if name == "pglog":
@@ -1302,7 +1356,11 @@ class OSDService(MapFollower):
 
     def _push_shard(self, pool_id, ps, osd, oid, shard, data, size,
                     v, qos: str = "recovery", force: bool = False,
-                    expect: Optional[str] = None) -> bool:
+                    expect: Optional[str] = None) -> Optional[Dict]:
+        """One shard write, local or remote.  Returns the holder's
+        reply (so callers can distinguish `superseded` — the holder
+        kept its newer version — from a genuine persist) or None on
+        transport failure."""
         msg = {"type": "shard_write", "pool": pool_id, "ps": ps,
                "oid": oid, "shard": shard, "data": data.hex(),
                "size": size, "v": v, "qos_class": qos}
@@ -1314,14 +1372,11 @@ class OSDService(MapFollower):
                 # direct: the caller is already a scheduled worker or
                 # the RMW coordinator — re-submitting would deadlock
                 # the worker pool
-                self._do_shard_write(msg)
-            else:
-                rep = self.msgr.call(self.osd_addrs[osd], msg,
-                                     timeout=10)
-                return bool(rep.get("ok"))
-            return True
+                return self._do_shard_write(msg)
+            return self.msgr.call(self.osd_addrs[osd], msg,
+                                  timeout=10)
         except (TimeoutError, OSError):
-            return False
+            return None
 
     def _set_pg_temp(self, pool_id: int, ps: int,
                      osds: List[int]) -> None:

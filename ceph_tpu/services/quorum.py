@@ -86,6 +86,11 @@ class Quorum:
         # accepted-but-uncommitted entry: {"v": int, "e": int,
         # "entry": {...}} — never applied until mon_commit
         self.uncommitted: Optional[Dict] = None
+        # one promise per election epoch (Paxos: a node may ack only
+        # ONE proposer per ballot, or two same-epoch candidates can
+        # both assemble majorities and commit different entries at the
+        # same version): rank we acked at election_epoch, or None
+        self.promised_rank: Optional[int] = None
         self._lease_fetching = False
         self._lock = threading.RLock()
         self._running = False
@@ -99,19 +104,23 @@ class Quorum:
         m.register("mon_accept", self._h_accept)
         m.register("mon_commit", self._h_commit)
 
-    # -- lifecycle ------------------------------------------------------
-    def start(self) -> None:
         # restore the promise + staged entry a crash may have left
-        # (Paxos.cc reads accepted_pn / uncommitted from the store)
+        # (Paxos.cc reads accepted_pn / uncommitted from the store).
+        # In __init__, NOT start(): handlers are registered above, and
+        # an early mon_propose arriving before a later restore would
+        # persist fresh state over the crash-saved entry.
         loader = getattr(self.mon, "load_quorum_state", None)
         if loader is not None:
             st = loader() or {}
-            with self._lock:
-                self.election_epoch = max(self.election_epoch,
-                                          int(st.get(
-                                              "election_epoch", 0)))
-                if st.get("uncommitted"):
-                    self.uncommitted = st["uncommitted"]
+            self.election_epoch = max(self.election_epoch,
+                                      int(st.get("election_epoch", 0)))
+            if st.get("promised_rank") is not None:
+                self.promised_rank = int(st["promised_rank"])
+            if st.get("uncommitted"):
+                self.uncommitted = st["uncommitted"]
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> None:
         self._running = True
         self._thread = threading.Thread(target=self._tick_loop,
                                         daemon=True,
@@ -145,6 +154,7 @@ class Quorum:
         saver = getattr(self.mon, "store_quorum_state", None)
         if saver is not None:
             saver({"election_epoch": self.election_epoch,
+                   "promised_rank": self.promised_rank,
                    "uncommitted": self.uncommitted})
 
     # -- the ticker -------------------------------------------------------
@@ -197,6 +207,9 @@ class Quorum:
             e = self.election_epoch
             self.state = ELECTING
             self.leader_rank = None
+            # standing is a promise to ourselves at this epoch: we
+            # must not also ack another candidate at the same epoch
+            self.promised_rank = self.rank
             # stagger retries by rank so the lowest reachable rank
             # converges first instead of livelocking
             self._next_election = time.monotonic() + \
@@ -242,12 +255,18 @@ class Quorum:
                 return {"ack": False, "epoch": self.election_epoch}
             if e > self.election_epoch:
                 self.election_epoch = e
+                self.promised_rank = None  # new epoch, new promise
                 # a new round invalidates current leadership
                 if self.state in (LEADER, PEON):
                     self.state = ELECTING
                     self.leader_rank = None
-            ack = r < self.rank
+            # one promise per epoch: two same-epoch candidates must
+            # never both collect majorities (they would each replicate
+            # a different entry at the same version)
+            ack = r < self.rank and \
+                self.promised_rank in (None, r)
             if ack:
+                self.promised_rank = r
                 # the promise must be durable before it leaves: a
                 # restarted peon that forgot this epoch could ack a
                 # deposed leader's accept at the same version
@@ -327,6 +346,8 @@ class Quorum:
         with self._lock:
             if e < self.election_epoch:
                 return {"ok": False, "epoch": self.election_epoch}
+            if e > self.election_epoch:
+                self.promised_rank = None
             self.election_epoch = e
             self.state = PEON if leader != self.rank else LEADER
             self.leader_rank = leader
@@ -369,6 +390,8 @@ class Quorum:
             if e < self.election_epoch:
                 return {"ok": False, "epoch": self.election_epoch}
             if e > self.election_epoch or self.leader_rank != leader:
+                if e > self.election_epoch:
+                    self.promised_rank = None
                 self.election_epoch = e
                 self.leader_rank = leader
                 self.state = PEON if leader != self.rank else LEADER

@@ -29,6 +29,7 @@ import os
 import pathlib
 import subprocess
 import sys
+import threading
 import time
 
 REPO = pathlib.Path(__file__).resolve().parent
@@ -233,11 +234,17 @@ def attempt():
           file=sys.stderr)
     rec["outcome"] = "up"
     rec["results"] = []
+    doc["attempts"].append(rec)
     proc = subprocess.Popen(
         [sys.executable, str(REPO / "tpu_probe.py"), "--worker",
          "stages"], env=env, stdout=subprocess.PIPE, stderr=None,
         text=True, cwd=str(REPO))
-    end = time.monotonic() + STAGE_DEADLINE
+    # hard watchdog: a tunnel drop mid-stage hangs the worker with no
+    # further output, and a blocked readline would otherwise stall the
+    # probe loop for the rest of the round
+    watchdog = threading.Timer(STAGE_DEADLINE, proc.kill)
+    watchdog.daemon = True
+    watchdog.start()
     try:
         for line in proc.stdout:
             if line.startswith(RESULT_TAG):
@@ -245,18 +252,13 @@ def attempt():
                 r["ts"] = _now()
                 rec["results"].append(r)
                 print(f"# stage landed: {r}", file=sys.stderr)
-                _save_artifact(doc if rec in doc["attempts"] else
-                               _push(doc, rec))
-            if time.monotonic() > end:
-                proc.kill()
-                rec["detail"] = "stage deadline hit"
-                break
+                _save_artifact(doc)
     finally:
+        watchdog.cancel()
         if proc.poll() is None:
             proc.kill()
+            rec["detail"] = "stage deadline hit"
         proc.wait()
-    if rec not in doc["attempts"]:
-        doc["attempts"].append(rec)
     crush = [r for r in rec["results"] if r.get("stage") == "crush"]
     if crush:
         best = max(crush, key=lambda r: r.get("rate", 0.0))
@@ -269,11 +271,6 @@ def attempt():
     _save_artifact(doc)
     _commit_artifact("TPU probe: tunnel up, stage results recorded")
     return bool(rec["results"])
-
-
-def _push(doc, rec):
-    doc["attempts"].append(rec)
-    return doc
 
 
 def main():
