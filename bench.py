@@ -310,8 +310,10 @@ def _stage_ec_profiles():
 
     import numpy as np
 
+    from ceph_tpu.ec.native_gf import engine_choice
     from ceph_tpu.ec.registry import factory
 
+    engine = f"{engine_choice()}-cpu"
     rng = np.random.default_rng(1)
     size = 1 << 20
 
@@ -330,11 +332,8 @@ def _stage_ec_profiles():
     for _ in range(iters):
         code.decode({0, 5}, dict(avail))
     dec = size * iters / (_t.perf_counter() - t0) / 1e9
-    # these ride the plugin registry's portable bit-plane engine —
-    # the CPU fallback of the TPU Pallas path, not the native GF
-    # engine the headline EC stage uses
     _emit(stage="ec_profile", profile="jerasure k=4,m=2",
-          engine="bitplane-cpu", encode_gbps=round(enc, 3),
+          engine=engine, encode_gbps=round(enc, 3),
           decode_gbps=round(dec, 3))
 
     lrc = factory("lrc", {"k": "4", "m": "2", "l": "3"})
@@ -348,7 +347,7 @@ def _stage_ec_profiles():
         lrc.decode({lost}, dict(avail))
     rep = size * iters / (_t.perf_counter() - t0) / 1e9
     _emit(stage="ec_profile", profile="lrc k=4,m=2,l=3",
-          engine="bitplane-cpu",
+          engine=engine,
           local_repair_gbps=round(rep, 3),
           repair_reads=len(need), total_chunks=n)
 

@@ -66,6 +66,14 @@ class ErasureCodeIsa(ErasureCode):
         else:
             full = M.isa_gf_gen_rs_matrix(self.k, self.m)
         coding = full[self.k:]
+        from .native_gf import NativeMatrixCode, engine_choice
+
+        if engine_choice() == "native":
+            # the ec_encode_data role on its native engine (isa-l is
+            # GF(2^8) table asm; this is the same math via the C++
+            # OpenMP kernel) — same bytes as the bit-plane engine
+            self._code = NativeMatrixCode(self.k, self.m, coding)
+            return
         cb = GFW(8).expand_bitmatrix(coding)
         self._code = BitCode(self.k, self.m, cb, Layout(8))
 

@@ -2,13 +2,19 @@
 
 The isa-l role on the host: RS encode/decode as table-driven GF(2^8)
 matrix application (native/crush_host.cpp gf8_matmul, OpenMP over
-rows).  The TPU path stays the MXU bit-matmul (engine.BitCode /
-pallas_kernels); this backs the bench's CPU fallback and host tools so
-the EC throughput number is a real engine on every platform.
+rows).  Two consumers:
+
+- the bench's CPU EC figure and host tools;
+- the plugin registry's w=8 matrix techniques (jerasure RS, isa),
+  via :class:`NativeMatrixCode` — the OSD/client data path operates
+  on per-op chunks far below the size where accelerator dispatch
+  pays for itself, so the host engine is the default there EVEN on
+  a TPU host (CEPH_TPU_EC_ENGINE=bitplane opts back into the
+  array/Pallas engine, which remains the large-batch bench path).
 
 Parity is identical to the array engines by construction: both apply
 the SAME generator matrices (gf.py) over the same field (poly 0x11D),
-pinned by tests.
+pinned by tests (tests/test_native_gf.py cross-engine byte equality).
 """
 
 from __future__ import annotations
@@ -59,37 +65,103 @@ def gf8_matmul(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
     return out
 
 
-class NativeRS:
-    """RS(k, m) on the native engine — mirrors rs_jax.RSCode's array
-    API for host-side callers."""
+def engine_choice() -> str:
+    """Which engine the plugin registry should put behind w=8 MATRIX
+    techniques: 'native' (the GF(2^8) table engine — the isa-l role,
+    7-40x the portable bit-plane engine on CPU) unless overridden via
+    CEPH_TPU_EC_ENGINE=bitplane or the native library is unavailable.
+    Mirrors the reference's plugin-selection rationale
+    (src/erasure-code/isa/ErasureCodeIsa.cc:333-336: pick the fastest
+    verified engine for the shape)."""
+    import os
 
-    def __init__(self, k: int, m: int, technique: str = "reed_sol_van"):
+    forced = os.environ.get("CEPH_TPU_EC_ENGINE", "")
+    if forced == "bitplane":
+        return "bitplane"
+    if forced == "native":
+        if not available():
+            raise RuntimeError(
+                "CEPH_TPU_EC_ENGINE=native but the native GF engine "
+                "failed to build/load — unset it or fix the toolchain")
+        return "native"
+    return "native" if available() else "bitplane"
+
+
+class NativeMatrixCode:
+    """BitCode-compatible facade over the native GF(2^8) engine for
+    w=8 matrix techniques (jerasure reed_sol_van/reed_sol_r6_op w=8,
+    every isa technique).
+
+    Same generator matrices as the bit-plane engine — parity bytes are
+    identical by construction (pinned by the EC corpus tests); only
+    the execution engine differs.  Interface mirrors engine.BitCode:
+    encode / all_chunks / decode_data / decode."""
+
+    def __init__(self, k: int, m: int, coding_rows: np.ndarray):
         self.k, self.m = k, m
-        if technique in ("reed_sol_van", "vandermonde"):
-            self.G = gf.rs_vandermonde_matrix(k, m)
-        else:
-            self.G = gf.rs_cauchy_matrix(k, m)
+        rows = np.asarray(coding_rows, np.uint8)
+        assert rows.shape == (m, k), rows.shape
+        self.G = np.concatenate(
+            [np.eye(k, dtype=np.uint8), rows], axis=0)
         self._dec_cache: Dict[tuple, np.ndarray] = {}
 
-    def encode(self, data: np.ndarray) -> np.ndarray:
-        return gf8_matmul(np.asarray(self.G[self.k:], np.uint8), data)
+    def encode(self, data) -> np.ndarray:
+        data = np.asarray(data, np.uint8)
+        assert data.shape[0] == self.k
+        return gf8_matmul(self.G[self.k:], data)
 
-    def all_chunks(self, data: np.ndarray) -> np.ndarray:
-        return np.concatenate([np.asarray(data, np.uint8),
-                               self.encode(data)], axis=0)
+    def all_chunks(self, data) -> np.ndarray:
+        data = np.asarray(data, np.uint8)
+        return np.concatenate([data, self.encode(data)], axis=0)
 
-    def decode(self, chunks: Dict[int, np.ndarray],
-               erasures: Sequence[int]) -> np.ndarray:
-        present = tuple(sorted(
-            i for i in chunks if i not in set(erasures)))[:self.k]
-        if len(present) < self.k:
+    def decode_data(self, chunks: Dict[int, np.ndarray]) -> np.ndarray:
+        avail = sorted(chunks)
+        if len(avail) < self.k:
             raise ValueError("need at least k chunks")
+        present = tuple(avail[:self.k])
         dm = self._dec_cache.get(present)
         if dm is None:
-            dm = np.asarray(
-                gf.decode_matrix(self.G, list(present), self.k),
-                np.uint8)
+            dm = np.asarray(gf.decode_matrix(self.G, list(present),
+                                             self.k), np.uint8)
+            if len(self._dec_cache) >= 512:  # IsaTableCache-style bound
+                self._dec_cache.pop(next(iter(self._dec_cache)))
             self._dec_cache[present] = dm
         stack = np.stack([np.asarray(chunks[i], np.uint8)
                           for i in present])
         return gf8_matmul(dm, stack)
+
+    def decode(self, want: Sequence[int],
+               chunks: Dict[int, np.ndarray]) -> Dict[int, np.ndarray]:
+        have = {i: np.asarray(c, np.uint8) for i, c in chunks.items()}
+        missing = [i for i in want if i not in have]
+        if missing:
+            data = self.decode_data(have)
+            for i in range(self.k):
+                if i not in have:
+                    have[i] = data[i]
+            par_missing = [i for i in missing if i >= self.k]
+            if par_missing:
+                parity = self.encode(data)
+                for i in par_missing:
+                    have[i] = parity[i - self.k]
+        return {i: have[i] for i in want}
+
+
+class NativeRS(NativeMatrixCode):
+    """RS(k, m) on the native engine — mirrors rs_jax.RSCode's array
+    API for host-side callers (a thin facade over NativeMatrixCode:
+    one decode-cache implementation to keep in sync, not two)."""
+
+    def __init__(self, k: int, m: int, technique: str = "reed_sol_van"):
+        if technique in ("reed_sol_van", "vandermonde"):
+            G = gf.rs_vandermonde_matrix(k, m)
+        else:
+            G = gf.rs_cauchy_matrix(k, m)
+        super().__init__(k, m, np.asarray(G[k:], np.uint8))
+
+    # rs_jax.RSCode decode signature: (chunks, erasures) -> data rows
+    def decode(self, chunks: Dict[int, np.ndarray],  # type: ignore[override]
+               erasures: Sequence[int]) -> np.ndarray:
+        avail = {i: c for i, c in chunks.items()
+                 if i not in set(erasures)}
+        return self.decode_data(avail)

@@ -38,3 +38,41 @@ def test_native_rs_equals_engine(k, m):
         assert np.array_equal(got, data), erasures
     with pytest.raises(ValueError):
         nat.decode({0: full[0]}, [])
+
+
+@pytest.mark.parametrize("plugin,profile", [
+    ("jerasure", {"technique": "reed_sol_van", "k": "4", "m": "2",
+                  "w": "8"}),
+    ("jerasure", {"technique": "reed_sol_r6_op", "k": "4", "m": "2",
+                  "w": "8"}),
+    ("isa", {"technique": "reed_sol_van", "k": "4", "m": "2"}),
+    ("isa", {"technique": "cauchy", "k": "6", "m": "3"}),
+])
+def test_plugin_engines_byte_identical(monkeypatch, plugin, profile):
+    """The registry's engine dispatch must be invisible: the native
+    GF(2^8) engine and the portable bit-plane engine produce the SAME
+    chunk bytes for every w=8 matrix technique (whichever one a given
+    machine defaults to, the other is covered here)."""
+    from ceph_tpu.ec.registry import factory
+
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, 1 << 14, dtype=np.uint8).tobytes()
+
+    out = {}
+    for engine in ("native", "bitplane"):
+        monkeypatch.setenv("CEPH_TPU_EC_ENGINE", engine)
+        code = factory(plugin, dict(profile))
+        n = code.get_chunk_count()
+        chunks = code.encode(range(n), data)
+        out[engine] = [np.asarray(chunks[i]) for i in range(n)]
+        # decode parity too: drop the first data + last parity chunk
+        k = code.get_data_chunk_count()
+        avail = {i: np.asarray(chunks[i]) for i in range(n)
+                 if i not in (0, n - 1)}
+        dec = code.decode({0, n - 1}, avail)
+        assert np.array_equal(np.asarray(dec[0]),
+                              np.asarray(chunks[0]))
+        assert np.array_equal(np.asarray(dec[n - 1]),
+                              np.asarray(chunks[n - 1]))
+    for a, b in zip(out["native"], out["bitplane"]):
+        assert np.array_equal(a, b)
