@@ -189,8 +189,11 @@ def worker_staged():
                iters=8 if on else 3, engine="xla-spec")
     _try_stage("gen/flat12", _stage_crush, "map_flat12", plat,
                batch=1 << 14, iters=4)
+    # gen mapper batch is HBM-bound on big maps: the general lowering
+    # materializes (batch, buckets, slots) intermediates, and 2^17
+    # lanes x 521 x 25 s32 overflowed v5e HBM (measured r5 probe)
     _try_stage("gen/big10k", _stage_crush, "map_big10k", plat,
-               batch=(1 << 17) if on else (1 << 13),
+               batch=(1 << 14) if on else (1 << 13),
                iters=8 if on else 2)
     _try_stage("ec/small", _stage_ec, plat, chunk=1 << 16, batch=4,
                iters=4, tag="small")

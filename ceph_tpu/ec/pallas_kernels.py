@@ -33,18 +33,23 @@ _LANE_TILE = 512  # lanes per grid step (multiple of 128)
 
 def _kernel(bm_ref, data_ref, out_ref, *, k: int, m: int):
     """One L-tile: u8[k, T] -> u8[m, T] through the resident bit
-    matrix int8[8m, 8k]."""
-    bits = jnp.arange(8, dtype=jnp.uint8)
-    d = data_ref[:]                                   # u8[k, T]
-    planes = (d[:, None, :] >> bits[None, :, None]) & jnp.uint8(1)
-    planes = planes.reshape(8 * k, d.shape[-1])       # u8[8k, T]
+    matrix int8[8m, 8k].
+
+    All intermediate arithmetic stays int32: the real-TPU Mosaic
+    lowering has no unsigned reductions ("Reductions over unsigned
+    integers not implemented"), so the plane unpack/repack must not
+    touch u8/u32 until the final store."""
+    bits = jnp.arange(8, dtype=jnp.int32)
+    d = data_ref[:].astype(jnp.int32)                 # i32[k, T]
+    planes = (d[:, None, :] >> bits[None, :, None]) & 1
+    planes = planes.reshape(8 * k, d.shape[-1])       # i32[8k, T]
     acc = jax.lax.dot_general(
         bm_ref[:], planes.astype(jnp.int8),
         (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)             # i32[8m, T]
-    par = (acc & 1).astype(jnp.uint8).reshape(m, 8, d.shape[-1])
+    par = (acc & 1).reshape(m, 8, d.shape[-1])        # i32
     out_ref[:] = jnp.sum(par << bits[None, :, None], axis=1,
-                         dtype=jnp.uint8)             # u8[m, T]
+                         dtype=jnp.int32).astype(jnp.uint8)
 
 
 @functools.partial(jax.jit,

@@ -193,9 +193,45 @@ def _crc_setup():
     return _crc_tables
 
 
+_native_crc = None
+
+
+def _native_crc32c():
+    """The slicing-by-8 C engine (native/crush_host.cpp crc32c_sb8) —
+    the src/common/crc32c.h hot-path role; bit-equality with the
+    Python table walker below is pinned by tests/test_stripe.py."""
+    global _native_crc
+    if _native_crc is None:
+        try:
+            import ctypes
+
+            from ..crush.native import ensure_built
+
+            lib = ensure_built()
+            if lib is None:
+                _native_crc = False
+            else:
+                lib.crc32c_sb8.restype = ctypes.c_uint32
+                lib.crc32c_sb8.argtypes = [
+                    ctypes.c_uint32,
+                    np.ctypeslib.ndpointer(np.uint8,
+                                           flags="C_CONTIGUOUS"),
+                    ctypes.c_int64]
+                _native_crc = lib.crc32c_sb8
+        except Exception:
+            _native_crc = False
+    return _native_crc or None
+
+
 def crc32c(data: bytes | np.ndarray, crc: int = 0xFFFFFFFF) -> int:
     """ceph_crc32c semantics (seed as passed, no final xor; the OSD
     uses -1)."""
+    fn = _native_crc32c()
+    if fn is not None:
+        buf = np.frombuffer(data, np.uint8) if isinstance(
+            data, (bytes, bytearray)) \
+            else np.ascontiguousarray(np.asarray(data, np.uint8).ravel())
+        return int(fn(crc & 0xFFFFFFFF, buf, len(buf)))
     t = _crc_setup()
     buf = np.frombuffer(data, np.uint8) if isinstance(
         data, (bytes, bytearray)) else np.asarray(data, np.uint8).ravel()

@@ -40,20 +40,26 @@ class Keyring:
 
     # -- frame authentication -----------------------------------------
     @staticmethod
-    def _canonical(msg: Dict) -> bytes:
+    def _canonical(msg: Dict, blobs=None) -> bytes:
         body = {k: v for k, v in msg.items() if k != "mac"}
-        return json.dumps(body, sort_keys=True,
-                          separators=(",", ":")).encode()
+        out = json.dumps(body, sort_keys=True,
+                         separators=(",", ":")).encode()
+        # data segments are covered by their digests, so a tampered
+        # raw attachment breaks the frame MAC exactly like a tampered
+        # control field
+        for b in (blobs or ()):
+            out += hashlib.sha256(b).digest()
+        return out
 
-    def sign(self, msg: Dict) -> str:
-        return hmac.new(self.key, self._canonical(msg),
+    def sign(self, msg: Dict, blobs=None) -> str:
+        return hmac.new(self.key, self._canonical(msg, blobs),
                         hashlib.sha256).hexdigest()
 
-    def verify(self, msg: Dict) -> bool:
+    def verify(self, msg: Dict, blobs=None) -> bool:
         mac = msg.get("mac")
         if not isinstance(mac, str):
             return False
-        return hmac.compare_digest(mac, self.sign(msg))
+        return hmac.compare_digest(mac, self.sign(msg, blobs))
 
     # -- session tickets (CephX ticket flow) --------------------------
     def issue_ticket(self, name: str,

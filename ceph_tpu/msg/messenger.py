@@ -1,12 +1,24 @@
-"""Messenger — threaded TCP transport with typed JSON dispatch and
+"""Messenger — threaded TCP transport with typed dispatch and
 session-layer reliability.
 
 The Messenger/Dispatcher seam (src/msg/Messenger.h, Dispatcher.h,
 AsyncMessenger.cc) plus the ProtocolV2 session layer
-(src/msg/async/ProtocolV2.cc): framing is 4-byte big-endian length +
-JSON body; on top of it, LOSSLESS peers (daemon↔daemon — the
-reference's CEPH_MSGR_POLICY_LOSSLESS) get sequence-numbered frames
-with ack/replay semantics:
+(src/msg/async/ProtocolV2.cc).
+
+Framing (the reference message's header/front/DATA segmentation,
+src/msg/Message.h: payload vs data bufferlists; ProtocolV2 rev1
+frames): one length word, a version byte, then a JSON control segment
+and N RAW binary segments.  ``bytes`` values anywhere in a message
+dict are lifted out of the control segment and travel as raw
+attachments — zero hex/base64 inflation, no JSON escaping, exactly
+like MOSDOp carrying its data payload outside the front segment.  The
+control segment optionally zlib-compresses (wire compression role);
+data segments never do (payload bytes are entropy-dense, and the
+reference compresses per-policy, not always).
+
+On top of it, LOSSLESS peers (daemon↔daemon — the reference's
+CEPH_MSGR_POLICY_LOSSLESS) get sequence-numbered frames with
+ack/replay semantics:
 
 - every sequenced frame carries (_sess, _s); the receiver keeps
   in_seq per (peer, session) and a bounded reply cache, so a frame
@@ -56,48 +68,103 @@ _UNACKED_CAP = 512      # frames buffered per lossless peer session
 _REPLY_CACHE_CAP = 128  # replies cached per remote session
 
 
-# frames beyond this compress on the wire (a 10k-OSD full map as JSON
-# is ~MBs; zlib takes it down ~15x, which is what keeps full-map
-# fetches viable until a binary map encode replaces the JSON body)
+# control segments beyond this compress on the wire (map payloads and
+# other large JSON; raw data segments are never compressed)
 _COMPRESS_OVER = 16 << 10
-_ZBIT = 0x80000000  # high bit of the length word = zlib body
+_FRAME_V = 2        # frame format version byte
+_FL_ZLIB = 0x01     # control segment is zlib-compressed
+
+_BLOB_KEY = "__frame_blob__"
 
 
-def _send_frame(sock: socket.socket, msg: Dict) -> None:
+def _lift_blobs(obj, blobs: list):
+    """Replace every bytes-like value with a data-segment reference —
+    the front/data split of the reference's Message bufferlists."""
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        blobs.append(bytes(obj))
+        return {_BLOB_KEY: len(blobs) - 1}
+    if isinstance(obj, dict):
+        return {k: _lift_blobs(v, blobs) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_lift_blobs(v, blobs) for v in obj]
+    return obj
+
+
+def _restore_blobs(obj, blobs: list):
+    if isinstance(obj, dict):
+        if len(obj) == 1 and _BLOB_KEY in obj:
+            return blobs[obj[_BLOB_KEY]]
+        return {k: _restore_blobs(v, blobs) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_restore_blobs(v, blobs) for v in obj]
+    return obj
+
+
+def _send_frame(sock: socket.socket, msg: Dict, keyring=None) -> None:
     import zlib
 
-    body = json.dumps(msg).encode()
-    length = len(body)
-    if length > _COMPRESS_OVER:
+    blobs: list = []
+    jmsg = _lift_blobs(msg, blobs)
+    if keyring is not None:
+        jmsg.pop("mac", None)
+        jmsg["mac"] = keyring.sign(jmsg, blobs)
+    body = json.dumps(jmsg).encode()
+    flags = 0
+    if len(body) > _COMPRESS_OVER:
         body = zlib.compress(body, 1)
-        length = len(body) | _ZBIT
+        flags |= _FL_ZLIB
+    parts = [struct.pack("<BBI", _FRAME_V, flags, len(body)), body,
+             struct.pack("<I", len(blobs))]
+    for b in blobs:
+        parts.append(struct.pack("<I", len(b)))
+        parts.append(b)
+    payload = b"".join(parts)
     with _send_locks_guard:
         lock = _send_locks.setdefault(id(sock), threading.Lock())
     with lock:
-        sock.sendall(struct.pack(">I", length) + body)
+        sock.sendall(struct.pack(">I", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int):
+    buf = b""
+    while len(buf) < n:
+        got = sock.recv(min(1 << 20, n - len(buf)))
+        if not got:
+            return None
+        buf += got
+    return buf
 
 
 def _recv_frame(sock: socket.socket):
+    """Returns (msg, blobs, nbytes) or None on EOF.  ``msg`` still
+    holds data-segment references; the dispatcher restores them after
+    MAC verification."""
     import zlib
 
-    header = b""
-    while len(header) < 4:
-        got = sock.recv(4 - len(header))
-        if not got:
-            return None
-        header += got
+    header = _recv_exact(sock, 4)
+    if header is None:
+        return None
     (length,) = struct.unpack(">I", header)
-    packed = bool(length & _ZBIT)
-    length &= ~_ZBIT
-    body = b""
-    while len(body) < length:
-        got = sock.recv(min(65536, length - len(body)))
-        if not got:
-            return None
-        body += got
-    if packed:
+    payload = _recv_exact(sock, length)
+    if payload is None:
+        return None
+    ver, flags, jlen = struct.unpack_from("<BBI", payload, 0)
+    if ver != _FRAME_V:
+        raise ValueError(f"unknown frame version {ver}")
+    pos = 6
+    body = payload[pos:pos + jlen]
+    pos += jlen
+    if flags & _FL_ZLIB:
         body = zlib.decompress(body)
-    return json.loads(body.decode()), len(body)
+    (nblobs,) = struct.unpack_from("<I", payload, pos)
+    pos += 4
+    blobs = []
+    for _ in range(nblobs):
+        (blen,) = struct.unpack_from("<I", payload, pos)
+        pos += 4
+        blobs.append(payload[pos:pos + blen])
+        pos += blen
+    return json.loads(body.decode()), blobs, length
 
 
 class _OutSession:
@@ -188,6 +255,10 @@ class Messenger:
         self._pending: Dict[str, Dict] = {}
         self._waiting: set = set()  # tids with a live waiter
         self._pending_cv = threading.Condition()
+        # lazy dispatch pool (DispatchQueue role); created on first
+        # inbound op so pure clients never spawn it
+        self._pool = None
+        self._pool_lock = threading.Lock()
 
     # -- dispatch ------------------------------------------------------
     def register(self, type_: str, handler: Handler) -> None:
@@ -205,6 +276,11 @@ class Messenger:
         while self._running:
             try:
                 conn, _ = self._listener.accept()
+                # ms_tcp_nodelay (on by default in the reference):
+                # Nagle + delayed ACK turns the request/ack/reply
+                # triple into double-digit-ms stalls
+                conn.setsockopt(socket.IPPROTO_TCP,
+                                socket.TCP_NODELAY, 1)
             except socket.timeout:
                 continue
             except OSError:
@@ -223,8 +299,8 @@ class Messenger:
                     break  # closed or corrupt frame: drop the session
                 if got is None:
                     break
-                msg, nbytes = got
-                self._dispatch(conn, msg, nbytes)
+                msg, blobs, nbytes = got
+                self._dispatch(conn, msg, blobs, nbytes)
         with _send_locks_guard:
             _send_locks.pop(id(conn), None)
         if addr is not None:
@@ -255,16 +331,18 @@ class Messenger:
             except (OSError, TimeoutError):
                 time.sleep(0.1 * (attempt + 1))
 
-    def _sign(self, msg: Dict) -> Dict:
-        if self.keyring is not None:
-            msg = dict(msg)
-            msg["mac"] = self.keyring.sign(msg)
-        return msg
+    def _send(self, conn: socket.socket, msg: Dict) -> None:
+        """Sign-at-wire-time send: frames are stored/buffered unsigned
+        (and may hold raw ``bytes`` values); the MAC is computed over
+        the lifted control segment + data-segment digests."""
+        _send_frame(conn, msg, self.keyring)
 
-    def _dispatch(self, conn: socket.socket, msg: Dict,
+    def _dispatch(self, conn: socket.socket, msg: Dict, blobs: list,
                   nbytes: int) -> None:
-        if self.keyring is not None and not self.keyring.verify(msg):
+        if self.keyring is not None and \
+                not self.keyring.verify(msg, blobs):
             return  # unauthenticated frame: drop silently (cephx deny)
+        msg = _restore_blobs(msg, blobs)
         type_ = msg.get("type", "")
         if type_ == "__reply__":
             with self._pending_cv:
@@ -301,19 +379,51 @@ class Messenger:
                 # original is still being handled on another thread,
                 # wait briefly for its reply to land in the cache.
                 if msg.get("tid") is not None:
-                    deadline = time.monotonic() + 2.0
-                    while time.monotonic() < deadline:
-                        with self._in_lock:
-                            cached = ins.replies.get(seq)
-                        if cached is not None:
-                            try:
-                                _send_frame(conn, cached)
-                            except OSError:
-                                pass
-                            return
-                        time.sleep(0.02)
+                    self._pool_submit(self._resend_cached, conn, ins,
+                                      seq)
                 return
 
+        # handler execution moves OFF the reader thread (the
+        # reference's DispatchQueue + fast-dispatch workers,
+        # src/msg/DispatchQueue.h): one connection can have many ops
+        # in flight — without this, a primary fanning a write out to
+        # replicas serializes every other op sharing the connection
+        # behind the fan-out's round trips.  Sequencing/dedup stays on
+        # the reader (above): in_seq is final by now; per-object order
+        # is owned by PG locks + versions, as in the reference's
+        # sharded op queues.
+        self._pool_submit(self._handle, conn, msg, ins, seq, nbytes)
+
+    def _resend_cached(self, conn, ins: _InSession, seq: int) -> None:
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            with self._in_lock:
+                cached = ins.replies.get(seq)
+            if cached is not None:
+                try:
+                    self._send(conn, cached)
+                except OSError:
+                    pass
+                return
+            time.sleep(0.02)
+
+    def _pool_submit(self, fn, *args) -> None:
+        with self._pool_lock:
+            pool = self._pool
+            if pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                pool = self._pool = ThreadPoolExecutor(
+                    max_workers=16,
+                    thread_name_prefix=f"msgr-dispatch:{self.name}")
+        try:
+            pool.submit(fn, *args)
+        except RuntimeError:
+            pass  # shutting down
+
+    def _handle(self, conn: socket.socket, msg: Dict,
+                ins: Optional[_InSession], seq, nbytes: int) -> None:
+        type_ = msg.get("type", "")
         throttle = self.throttles.get(type_)
         if throttle is not None:
             if nbytes > throttle.max:
@@ -337,11 +447,10 @@ class Messenger:
 
         frame = None
         if msg.get("tid") is not None:
-            frame = self._sign({"type": "__reply__",
-                                "tid": msg["tid"],
-                                "payload": reply})
+            frame = {"type": "__reply__", "tid": msg["tid"],
+                     "payload": reply}
             try:
-                _send_frame(conn, frame)
+                self._send(conn, frame)
             except OSError:
                 pass
         if ins is not None:
@@ -350,18 +459,19 @@ class Messenger:
                     ins.cache_reply(seq, frame)
             # ack so the sender can trim its unacked buffer
             try:
-                _send_frame(conn, self._sign(
-                    {"type": "__ack__", "sess": msg.get("_sess"),
-                     "in_seq": seq, "addr": list(self.addr)}))
+                self._send(conn, {"type": "__ack__",
+                                  "sess": msg.get("_sess"),
+                                  "in_seq": seq,
+                                  "addr": list(self.addr)})
             except OSError:
                 pass
 
     def _reply(self, conn, msg: Dict, payload: Dict) -> None:
         if msg.get("tid") is not None:
             try:
-                _send_frame(conn, self._sign(
-                    {"type": "__reply__", "tid": msg["tid"],
-                     "payload": payload}))
+                self._send(conn, {"type": "__reply__",
+                                  "tid": msg["tid"],
+                                  "payload": payload})
             except OSError:
                 pass
 
@@ -373,6 +483,8 @@ class Messenger:
             if sock is not None:
                 return sock
             sock = socket.create_connection(addr, timeout=5)
+            sock.setsockopt(socket.IPPROTO_TCP,
+                            socket.TCP_NODELAY, 1)
             self._conns[addr] = sock
             threading.Thread(target=self._reader, args=(sock, addr),
                              daemon=True).start()
@@ -399,12 +511,12 @@ class Messenger:
         """tid-correlated exchange below the session layer (the
         handshake itself must not be sequenced)."""
         tid = uuid.uuid4().hex
-        msg = self._sign(dict(msg, tid=tid, frm=self.name))
+        msg = dict(msg, tid=tid, frm=self.name)
         deadline = time.monotonic() + timeout
         with self._pending_cv:
             self._waiting.add(tid)
         try:
-            _send_frame(self._connect(addr), msg)
+            self._send(self._connect(addr), msg)
             with self._pending_cv:
                 while tid not in self._pending:
                     remaining = deadline - time.monotonic()
@@ -435,7 +547,7 @@ class Messenger:
         peer_in = int(rep.get("in_seq", 0))
         sess.trim(peer_in)
         for frame in sess.pending():
-            _send_frame(sock, frame)
+            self._send(sock, frame)
         sess.synced = True
 
     def _send_sequenced(self, addr: Addr, msg: Dict) -> int:
@@ -444,13 +556,12 @@ class Messenger:
         with sess.lock:
             sess.out_seq += 1
             seq = sess.out_seq
-            frame = self._sign(dict(msg, _s=seq,
-                                    _sess=self.session_id,
-                                    frm=self.name))
+            frame = dict(msg, _s=seq, _sess=self.session_id,
+                         frm=self.name)
             sess.buffer(seq, frame, msg.get("tid") is not None)
             try:
                 if sess.synced:
-                    _send_frame(self._connect(addr), frame)
+                    self._send(self._connect(addr), frame)
                 else:
                     self._ensure_synced(addr)  # replays incl. frame
             except (OSError, TimeoutError):
@@ -478,10 +589,9 @@ class Messenger:
             except (OSError, TimeoutError):
                 pass  # unacked buffer + resync own the retry
             return
-        msg = self._sign(msg)
         for _ in range(2):
             try:
-                _send_frame(self._connect(addr), msg)
+                self._send(self._connect(addr), msg)
                 return
             except OSError:
                 self._drop(addr)
@@ -501,14 +611,14 @@ class Messenger:
             if self.lossless:
                 seq = self._send_sequenced(addr, dict(msg, tid=tid))
             else:
-                smsg = self._sign(dict(msg, tid=tid, frm=self.name))
+                smsg = dict(msg, tid=tid, frm=self.name)
                 try:
-                    _send_frame(self._connect(addr), smsg)
+                    self._send(self._connect(addr), smsg)
                 except OSError:
                     # stale cached connection (peer restarted): one
                     # fresh reconnect before giving up
                     self._drop(addr)
-                    _send_frame(self._connect(addr), smsg)
+                    self._send(self._connect(addr), smsg)
             with self._pending_cv:
                 while tid not in self._pending:
                     remaining = deadline - time.monotonic()
@@ -533,6 +643,10 @@ class Messenger:
 
     def shutdown(self) -> None:
         self._running = False
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
         try:
             self._listener.close()
         except OSError:

@@ -18,7 +18,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from ..common.version import bump, make_version
+from ..common.version import make_version
 from ..msg.messenger import Addr, Messenger
 from ..osdmap.osdmap import OSDMap, POOL_TYPE_ERASURE
 from ..ec.registry import profile_factory
@@ -28,16 +28,6 @@ class ObjectNotFound(KeyError):
     """Every reachable shard holder answered ENOENT — the object does
     not exist (distinct from transient unreachability, which raises
     TimeoutError/OSError and is retried)."""
-
-
-class _Superseded(OSError):
-    """A shard holder discarded our write because it already stores a
-    newer version — our wall clock lags.  Carries the stored version
-    so the retry can stamp past it (read-your-writes repair)."""
-
-    def __init__(self, cur: str):
-        super().__init__(f"write superseded by stored version {cur}")
-        self.cur = cur
 
 
 def object_to_ps(oid: str) -> int:
@@ -101,24 +91,19 @@ class Client(MapFollower):
         the serving-continuity contract of peering (OSDMap.cc:2590)."""
         pool = self.map.pools[pool_id]
         ps = object_to_ps(oid) % pool.pg_num
-        up, _p, acting, _ap = self.map.pg_to_up_acting_osds(pool_id,
-                                                           ps)
+        up, _p, acting, _ap = self.pg_up_acting(pool_id, ps)
         return pool, ps, (acting if acting else up)
 
     # -- data path -------------------------------------------------------
     def put(self, pool_id: int, oid: str, data: bytes,
             retries: int = 3) -> None:
-        # one version for every shard of this logical write: replicas
-        # agree on recency at peering time (the eversion_t role).
-        # Stamped per attempt: a `superseded` reply means our clock
-        # lags the stored version, so the retry re-stamps PAST it
-        # (version floor) instead of being silently discarded while
-        # acked ok — that would break read-your-writes.
-        floor = None
+        """EVERY write routes through the PG primary (the reference
+        sends all ops to the primary, Objecter::_calc_target) — ONE
+        client round trip; the primary stamps the version under the
+        PG lock (eversion_t at the primary: immune to client clock
+        skew) and fans replicas/shards out in parallel."""
         for attempt in range(retries):
-            v = make_version(self.epoch)
-            if floor is not None and v <= floor:
-                v = bump(floor)
+            v = make_version(self.epoch)  # proposal; primary may bump
             try:
                 # inside the retry loop: a freshly-created pool may be
                 # a map epoch away (a peon served the refresh before
@@ -127,57 +112,33 @@ class Client(MapFollower):
                 pool, ps, up = self._up(pool_id, oid)
                 code = self._code_for(pool)
                 if code is None:
-                    for pos, osd in enumerate(up):
-                        self._write_shard(pool_id, ps, oid, osd, 0,
-                                          data, len(data), v)
+                    req = {"type": "rep_write", "pool": pool_id,
+                           "ps": ps, "oid": oid, "epoch": self.epoch,
+                           "data": bytes(data), "v": v}
                 else:
-                    # EC writes route through the PG primary, which
-                    # encodes and distributes under the PG lock — the
-                    # only way a write can serialize against peering's
-                    # divergent-shard rollback (the reference sends
-                    # every op to the primary for the same reason)
-                    prim = self._first_reachable(up)
-                    if prim is None:
-                        raise TimeoutError("no reachable primary")
                     req = {"type": "ec_write", "pool": pool_id,
                            "ps": ps, "oid": oid, "offset": 0,
-                           "data": data.hex(), "v": v, "full": True}
-                    got = self.msgr.call(self.osd_addrs[prim], req,
-                                         timeout=20)
-                    if not got.get("ok") and \
-                            got.get("error") == "not primary" and \
-                            got.get("primary") in self.osd_addrs:
-                        got = self.msgr.call(
-                            self.osd_addrs[got["primary"]],
-                            dict(req), timeout=20)
-                    if not got.get("ok"):
-                        raise OSError(
-                            f"ec put via osd.{prim}: {got}")
+                           "epoch": self.epoch,
+                           "data": bytes(data), "v": v, "full": True}
+                prim = self._first_reachable(up)
+                if prim is None:
+                    raise TimeoutError("no reachable primary")
+                got = self.msgr.call(self.osd_addrs[prim], req,
+                                     timeout=20)
+                if not got.get("ok") and \
+                        got.get("error") == "not primary" and \
+                        got.get("primary") in self.osd_addrs:
+                    got = self.msgr.call(
+                        self.osd_addrs[got["primary"]],
+                        dict(req), timeout=20)
+                if not got.get("ok"):
+                    raise OSError(f"put via osd.{prim}: {got}")
                 return
-            except _Superseded as s:
-                if attempt + 1 == retries:
-                    raise
-                floor = max(floor or "", s.cur)
             except (TimeoutError, OSError, KeyError):
                 if attempt + 1 == retries:
                     raise
                 time.sleep(0.3)
                 self.refresh_map()
-
-    def _write_shard(self, pool_id, ps, oid, osd, shard, data,
-                     size, v=None) -> None:
-        got = self.msgr.call(self.osd_addrs[osd],
-                             {"type": "shard_write", "pool": pool_id,
-                              "ps": ps, "oid": oid, "shard": shard,
-                              "data": data.hex(), "size": size,
-                              "v": v},
-                             timeout=10)
-        if not got.get("ok"):
-            raise OSError(f"shard_write to osd.{osd}: {got}")
-        if got.get("superseded"):
-            # the OSD kept its newer version; acking this as success
-            # would break read-your-writes for a lagging clock
-            raise _Superseded(got.get("cur") or "")
 
     def get(self, pool_id: int, oid: str, retries: int = 3,
             notfound_retries: int = 2) -> bytes:
@@ -231,7 +192,7 @@ class Client(MapFollower):
             if "data" in got:
                 v = got.get("v") or ""
                 if best is None or v > best_v:
-                    best = bytes.fromhex(got["data"])[:got["size"]]
+                    best = bytes(got["data"])[:got["size"]]
                     best_v = v
                     agree = 1
                 elif v == best_v:
@@ -284,7 +245,7 @@ class Client(MapFollower):
                     self.osd_addrs[prim],
                     {"type": "ec_write", "pool": pool_id, "ps": ps,
                      "oid": oid, "offset": offset,
-                     "data": data.hex(), "v": v}, timeout=15)
+                     "data": bytes(data), "v": v}, timeout=15)
                 if got.get("ok"):
                     return
                 if got.get("error") == "not primary" and \
@@ -293,7 +254,7 @@ class Client(MapFollower):
                         self.osd_addrs[got["primary"]],
                         {"type": "ec_write", "pool": pool_id,
                          "ps": ps, "oid": oid, "offset": offset,
-                         "data": data.hex(), "v": v}, timeout=15)
+                         "data": bytes(data), "v": v}, timeout=15)
                     if got.get("ok"):
                         return
                 raise OSError(f"ec_write via osd.{prim}: {got}")
@@ -442,7 +403,7 @@ class Client(MapFollower):
             if "data" in got:
                 v = got.get("v") or ""
                 by_ver.setdefault(v, {})[pos] = np.frombuffer(
-                    bytes.fromhex(got["data"]), np.uint8)
+                    bytes(got["data"]), np.uint8)
                 sizes[v] = got["size"]
             elif got.get("error") == "enoent":
                 enoent += 1

@@ -196,7 +196,9 @@ class MiniCluster:
         """Deep-scrub every PG of a pool on every up OSD; returns
         {osd: [inconsistent shard names]} (non-empty = damage)."""
         payload = self.mon_command({"type": "get_map"})
-        m = OSDMap.from_dict(payload["map"])
+        from ..osdmap.bincode_maps import payload_map
+
+        m = payload_map(payload)
         pool = m.pools[pool_id]
         bad: Dict[int, list] = {}
         for ps in range(pool.pg_num):
@@ -273,7 +275,9 @@ class MiniCluster:
         on the OSD that should hold it."""
         def clean() -> bool:
             payload = self.mon_command({"type": "get_map"})
-            m = OSDMap.from_dict(payload["map"])
+            from ..osdmap.bincode_maps import payload_map
+
+            m = payload_map(payload)
             pool = m.pools[pool_id]
             from .client import object_to_ps
             for oid in objects:

@@ -115,12 +115,42 @@ class MapFollower:
         if "ec_profiles" in msg:
             self.ec_profiles = msg["ec_profiles"]
 
+    def pg_up_acting(self, pool_id: int, ps: int):
+        """Cached pg_to_up_acting_osds: the scalar CRUSH walk costs
+        ~0.4 ms and the data path asks per op; maps here are
+        copy-apply-swap (never mutated in place), so caching per
+        installed map object is sound.  Cleared on every swap."""
+        key = (pool_id, ps)
+        with self._lock:
+            cache = getattr(self, "_pg_cache", None)
+            if cache is None:
+                cache = self._pg_cache = {}
+            hit = cache.get(key)
+            if hit is not None:
+                return hit
+            m = self.map
+        val = m.pg_to_up_acting_osds(pool_id, ps)
+        with self._lock:
+            if self.map is m:
+                if len(cache) > 65536:
+                    cache.clear()
+                cache[key] = val
+        return val
+
     def _install_map(self, payload: Dict) -> None:
         with self._lock:
             if payload["epoch"] <= self.epoch:
                 return
-            self.map = OSDMap.from_dict(payload["map"])
+            if "map_bin" in payload:
+                # the wire form: versioned binary encode
+                # (OSDMap::encode role, ~15x smaller than the JSON)
+                from ..osdmap.bincode_maps import osdmap_from_bytes
+
+                self.map = osdmap_from_bytes(payload["map_bin"])
+            else:
+                self.map = OSDMap.from_dict(payload["map"])
             self.epoch = payload["epoch"]
+            self._pg_cache = {}
             self._set_extras(payload)
         self._post_map_install()
 
@@ -133,6 +163,7 @@ class MapFollower:
             apply_incremental(new, inc)
             self.map = new
             self.epoch = inc.epoch
+            self._pg_cache = {}
             return True
 
     def _h_map_inc(self, msg: Dict) -> None:

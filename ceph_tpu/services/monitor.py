@@ -355,6 +355,28 @@ class Monitor:
             raw = self._epochs.get(epoch)
         return json.loads(raw) if raw else None
 
+    def _wire_full(self, payload: Dict) -> Dict:
+        """Full-map payload for the WIRE: the map travels as its
+        versioned binary encode (OSDMap::encode role — ~200 KB for a
+        10k-OSD map vs ~3 MB of JSON), cached per epoch since every
+        subscriber gets the same bytes.  The JSON form stays in the
+        epoch STORE (debuggable, quorum-fetchable)."""
+        epoch = payload.get("epoch")
+        with self._lock:
+            cached = getattr(self, "_wire_cache", None)
+        if cached is not None and cached[0] == epoch:
+            map_bin = cached[1]
+        else:
+            from ..osdmap.bincode_maps import osdmap_to_bytes
+
+            map_bin = osdmap_to_bytes(OSDMap.from_dict(
+                payload["map"]))
+            with self._lock:
+                self._wire_cache = (epoch, map_bin)
+        p = {k: v for k, v in payload.items() if k != "map"}
+        p["map_bin"] = map_bin
+        return p
+
     def _push_maps(self) -> None:
         """Queue the newest committed epoch to every subscriber.  Each
         subscriber has its own pusher thread + bounded queue, so a hung
@@ -374,7 +396,8 @@ class Monitor:
         if inc is not None:
             msg = {"type": "map_inc", "inc": inc, **extras}
         else:
-            msg = {"type": "map_update", "payload": payload}
+            msg = {"type": "map_update",
+                   "payload": self._wire_full(payload)}
         for p in pushers:
             p.push(msg)
 
@@ -426,12 +449,13 @@ class Monitor:
         epoch = msg.get("epoch")
         if epoch is not None:
             got = self.get_epoch_payload(int(epoch))
-            return got if got is not None else \
+            return self._wire_full(got) if got is not None else \
                 {"error": f"no epoch {epoch}"}
         with self._lock:
             if self._committed_epoch == 0:
                 return {"error": "no committed map yet"}
-            return json.loads(self._epochs[self._committed_epoch])
+            payload = json.loads(self._epochs[self._committed_epoch])
+        return self._wire_full(payload)
 
     def _h_subscribe(self, msg: Dict) -> Dict:
         name, addr = msg["name"], tuple(msg["addr"])
@@ -449,7 +473,7 @@ class Monitor:
                 reply = json.loads(self._epochs[self._committed_epoch])
         if stale is not None:
             stale.stop()
-        return reply
+        return self._wire_full(reply) if "map" in reply else reply
 
     def _h_mark_down(self, msg: Dict) -> Dict:
         return {"epoch": self.mark_down(int(msg["osd"]))}

@@ -129,6 +129,7 @@ class OSDService(MapFollower):
                      ("shard_remove", self._h_shard_remove),
                      ("obj_delete", self._h_obj_delete),
                      ("ec_write", self._h_ec_write),
+                     ("rep_write", self._h_rep_write),
                      ("watch", self._h_watch),
                      ("unwatch", self._h_unwatch),
                      ("notify", self._h_notify),
@@ -189,6 +190,9 @@ class OSDService(MapFollower):
     def shutdown(self) -> None:
         self._running = False
         self._recover_wake.set()
+        pool = getattr(self, "_fanout_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
         self.sched.shutdown()
         self.msgr.shutdown()
         try:
@@ -274,7 +278,7 @@ class OSDService(MapFollower):
                 txn = Transaction()
                 if not self.store.collection_exists(cid):
                     txn.create_collection(cid)
-                data = bytes.fromhex(msg["data"])
+                data = bytes(msg["data"])
                 txn.write(cid, oid, 0, data)
                 # a shorter rewrite must never leave a stale tail:
                 # chunk boundaries shift and EC decode would interleave
@@ -320,7 +324,7 @@ class OSDService(MapFollower):
             size = self.store.getattr(cid, oid, "size") or b"0"
             ver = self.store.getattr(cid, oid, "v") or b""
             self.pc.inc("ops_r")
-            return {"data": data.hex(), "size": int(size),
+            return {"data": bytes(data), "size": int(size),
                     "v": ver.decode()}
 
     def _h_obj_delete(self, msg: Dict) -> Dict:
@@ -336,6 +340,16 @@ class OSDService(MapFollower):
                 txn.create_collection(cid)
             else:
                 prefix = f"{msg['oid']}.s"
+                if not msg.get("force"):
+                    # local version floor (same clock-skew repair as
+                    # the write path): a client delete must tombstone
+                    # ABOVE whatever is stored, or a lagging clock
+                    # leaves the object readable after an acked delete
+                    for name in self.store.list_objects(cid):
+                        if name.startswith(prefix):
+                            cur = self.store.getattr(cid, name, "v")
+                            if cur is not None and cur.decode() >= v:
+                                v = bump(cur.decode())
                 torn_cleanup = False
                 for name in self.store.list_objects(cid):
                     if not name.startswith(prefix):
@@ -395,6 +409,102 @@ class OSDService(MapFollower):
         # the scheduled, QoS-governed ops.
         return self._do_ec_write(msg)
 
+    def _fanout(self):
+        """Persistent replica fan-out pool (per-op thread spawn was a
+        measurable slice of write latency)."""
+        with self._lock:
+            pool = getattr(self, "_fanout_pool", None)
+            if pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                pool = self._fanout_pool = ThreadPoolExecutor(
+                    max_workers=8,
+                    thread_name_prefix=f"osd{self.id}-fanout")
+            return pool
+
+    def _map_for_op(self, msg: Dict):
+        """Epoch-tagged op handling (the reference OSD requests newer
+        maps when an op's client epoch exceeds its own,
+        OSD::require_same_or_newer_map): if the sender has seen a
+        newer epoch, catch up before deciding primariness/pools —
+        otherwise a freshly created pool 'does not exist' here until
+        the next push arrives."""
+        e = int(msg.get("epoch", 0))
+        if e > self.epoch:
+            self._catch_up(e, {})
+        with self._lock:
+            return self.map
+
+    def _h_rep_write(self, msg: Dict) -> Dict:
+        """Primary-coordinated replicated write (the PrimaryLogPG
+        do_op -> ReplicatedBackend submit_transaction -> MOSDRepOp
+        fan-out): ONE client round trip; the primary stamps the
+        version under the PG lock and pushes replicas in PARALLEL.
+        Replaces the client writing each replica itself — which cost
+        size serial RTTs and left version stamping at the client's
+        wall clock."""
+        pool_id, ps = int(msg["pool"]), int(msg["ps"])
+        oid = msg["oid"]
+        data = bytes(msg["data"])
+        m = self._map_for_op(msg)
+        if m is None:
+            return {"error": "no map"}
+        pool = m.pools.get(pool_id)
+        if pool is None:
+            return {"error": f"no pool {pool_id}"}
+        up, _p, acting, _ap = self.pg_up_acting(pool_id, ps)
+        members = acting if acting else up
+        prim = next((o for o in members if self._alive(o)), None)
+        if prim != self.id:
+            return {"error": "not primary", "primary": prim,
+                    "epoch": self.epoch}
+
+        with self._pg_lock(pool_id, ps):
+            v = msg.get("v") or make_version(self.epoch)
+            cid = pg_cid(pool_id, ps)
+            curb = self.store.getattr(cid, f"{oid}.s0", "v") \
+                if self.store.collection_exists(cid) else None
+            if curb is not None and v <= curb.decode():
+                v = bump(curb.decode())
+            targets = [o for o in dict.fromkeys(members)
+                       if o >= 0 and (o == self.id or self._alive(o))]
+            for _restamp in range(3):
+                replies: Dict[int, Optional[Dict]] = {}
+
+                def push(o):
+                    replies[o] = self._push_shard(
+                        pool_id, ps, o, oid, 0, data, len(data), v,
+                        qos="client")
+
+                others = [o for o in targets if o != self.id]
+                futs = [self._fanout().submit(push, o)
+                        for o in others]
+                push(self.id)  # local write on this thread
+                for f in futs:
+                    try:
+                        f.result(timeout=15)
+                    except Exception:
+                        pass
+                landed, newest = 0, None
+                for o, rep in replies.items():
+                    if rep is None or not rep.get("ok"):
+                        continue
+                    if rep.get("superseded"):
+                        newest = max(newest or "",
+                                     rep.get("cur") or "")
+                    else:
+                        landed += 1
+                if newest is None:
+                    break
+                v = bump(newest)
+            if landed < min(pool.min_size, len(targets)):
+                return {"error": f"only {landed} of "
+                                 f"{pool.min_size} required replicas "
+                                 f"persisted"}
+            self.pc.inc("ops_w")
+            return {"ok": True, "v": v,
+                    "degraded": landed < pool.size}
+
     def _do_ec_write(self, msg: Dict) -> Dict:
         """The ECBackend::start_rmw role (ECBackend.cc:1876-1976 +
         ECTransaction.cc:202 overwrite): the PG PRIMARY serializes
@@ -408,15 +518,14 @@ class OSDService(MapFollower):
         pool_id, ps = int(msg["pool"]), int(msg["ps"])
         oid = msg["oid"]
         offset = int(msg["offset"])
-        data = bytes.fromhex(msg["data"])
-        with self._lock:
-            m = self.map
+        data = bytes(msg["data"])
+        m = self._map_for_op(msg)
         if m is None:
             return {"error": "no map"}
         pool = m.pools.get(pool_id)
         if pool is None:
             return {"error": f"no pool {pool_id}"}
-        up, _p, acting, _ap = m.pg_to_up_acting_osds(pool_id, ps)
+        up, _p, acting, _ap = self.pg_up_acting(pool_id, ps)
         members = acting if acting else up
         prim = next((o for o in members if self._alive(o)), None)
         if prim != self.id:
@@ -552,7 +661,7 @@ class OSDService(MapFollower):
         except (TimeoutError, OSError):
             return None
         if "data" in got:
-            return (got.get("v") or "", bytes.fromhex(got["data"]),
+            return (got.get("v") or "", bytes(got["data"]),
                     int(got.get("size", 0)))
         return None
 
@@ -1362,7 +1471,7 @@ class OSDService(MapFollower):
         kept its newer version — from a genuine persist) or None on
         transport failure."""
         msg = {"type": "shard_write", "pool": pool_id, "ps": ps,
-               "oid": oid, "shard": shard, "data": data.hex(),
+               "oid": oid, "shard": shard, "data": bytes(data),
                "size": size, "v": v, "qos_class": qos}
         if force:
             msg["force"] = True

@@ -86,12 +86,11 @@ def main(argv=None) -> int:
             print(json.dumps(st.get("pgmap", {}), indent=1))
         elif v[:2] == ["osd", "tree"]:
             payload = call({"type": "get_map"})
-            from ..crush.map import CrushMap
             from ..crush.wrapper import CrushWrapper
+            from ..osdmap.bincode_maps import payload_map
             from .crushtool import cmd_tree
 
-            w = CrushWrapper(CrushMap.from_dict(
-                payload["map"]["crush"]))
+            w = CrushWrapper(payload_map(payload).crush)
             cmd_tree(w, sys.stdout)
         elif v[:2] == ["osd", "reweight"] and len(v) == 4:
             rc = mutate(call({"type": "reweight", "osd": int(v[2]),
@@ -103,10 +102,12 @@ def main(argv=None) -> int:
                               "osd": int(v[2])}))
         elif v[:2] == ["pool", "ls"]:
             payload = call({"type": "get_map"})
-            for pid, pool in sorted(payload["map"]["pools"].items(),
-                                    key=lambda kv: int(kv[0])):
-                print(f"pool {pid}: type {pool['pool_type']} "
-                      f"size {pool['size']} pg_num {pool['pg_num']}")
+            from ..osdmap.bincode_maps import payload_map
+
+            for pid, pool in sorted(payload_map(payload)
+                                    .pools.items()):
+                print(f"pool {pid}: type {pool.pool_type} "
+                      f"size {pool.size} pg_num {pool.pg_num}")
         elif v[:2] == ["pool", "create"] and len(v) == 5:
             rc = mutate(call(
                 {"type": "pool_create", "pool_id": int(v[2]),

@@ -185,7 +185,8 @@ def test_scrub_detects_and_repairs_corruption(cluster):
     payload = cluster.mon.msgr.call(cluster.mon.addr,
                                     {"type": "get_map"})
     from ceph_tpu.osdmap.osdmap import OSDMap
-    m = OSDMap.from_dict(payload["map"])
+    from ceph_tpu.osdmap.bincode_maps import payload_map
+    m = payload_map(payload)
     up, _p, _a, _ap = m.pg_to_up_acting_osds(2, ps)
     victim_osd = up[1]
     svc = cluster.osds[victim_osd]
@@ -275,7 +276,7 @@ def test_map_epoch_catchup(cluster):
     old = cluster.mon.msgr.call(cluster.mon.addr,
                                 {"type": "get_map", "epoch": cur - 1})
     assert old["epoch"] == cur - 1
-    assert "map" in old
+    assert "map_bin" in old or "map" in old  # wire form is binary
     missing = cluster.mon.msgr.call(cluster.mon.addr,
                                     {"type": "get_map", "epoch": 10 ** 9})
     assert "error" in missing
@@ -540,7 +541,8 @@ def test_scheduled_scrub_auto_repairs(tmp_path):
         ps = object_to_ps("ss-obj") % 8
         payload = c.mon_command({"type": "get_map"})
         from ceph_tpu.osdmap.osdmap import OSDMap as _OM
-        m = _OM.from_dict(payload["map"])
+        from ceph_tpu.osdmap.bincode_maps import payload_map as _pm
+        m = _pm(payload)
         up, _p, _a, _ap = m.pg_to_up_acting_osds(2, ps)
         victim = c.osds[up[1]]
         cid = f"2.{ps}"

@@ -84,18 +84,15 @@ def test_duplicate_sequenced_frame_not_reexecuted():
                     {"ok": True})
     try:
         client.call(server.addr, {"type": "op", "n": 1}, timeout=10)
-        # capture the exact signed frame the session layer produced
-        sess = client._out[tuple(server.addr)]
-        with sess.buf_lock:
-            frames = list(sess.unacked.values())
-        if not frames:  # already acked: rebuild the same frame
-            frames = [client._sign({"type": "op", "n": 1, "_s": 1,
-                                    "_sess": client.session_id,
-                                    "frm": client.name})]
+        # replay the same frame content with a valid signature (the
+        # capture scenario: signing is deterministic, so an on-path
+        # attacker's byte-identical frame carries this exact MAC)
+        frame = {"type": "op", "n": 1, "_s": 1,
+                 "_sess": client.session_id, "frm": client.name}
         import socket as _socket
 
         raw = _socket.create_connection(server.addr, timeout=5)
-        _send_frame(raw, frames[0])
+        _send_frame(raw, frame, kr)
         time.sleep(0.5)
         raw.close()
         assert calls == [1], f"replay executed: {calls}"
@@ -113,12 +110,12 @@ def test_tampered_frame_dropped():
     try:
         import socket as _socket
 
-        frame = client._sign({"type": "op", "n": 7, "_s": 1,
-                              "_sess": client.session_id,
-                              "frm": client.name})
+        frame = {"type": "op", "n": 7, "_s": 1,
+                 "_sess": client.session_id, "frm": client.name}
+        frame["mac"] = kr.sign(frame)
         frame["n"] = 8  # tamper after signing
         raw = _socket.create_connection(server.addr, timeout=5)
-        _send_frame(raw, frame)
+        _send_frame(raw, frame)  # no keyring: the stale mac rides along
         time.sleep(0.4)
         raw.close()
         assert calls == []

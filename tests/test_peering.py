@@ -53,8 +53,8 @@ def _wait_converged(cluster, pool_id, expect, timeout=30.0):
             # replica convergence: identical version xattrs everywhere
             from ceph_tpu.services.client import object_to_ps
             payload = cluster.mon_command({"type": "get_map"})
-            from ceph_tpu.osdmap.osdmap import OSDMap
-            m = OSDMap.from_dict(payload["map"])
+            from ceph_tpu.osdmap.bincode_maps import payload_map
+            m = payload_map(payload)
             pool = m.pools[pool_id]
             for oid, want in expect.items():
                 ps = object_to_ps(oid) % pool.pg_num

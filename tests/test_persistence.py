@@ -182,6 +182,7 @@ def test_pool_delete_and_reweight(tmp_path):
 
         c.reweight_osd(1, 0.5)
         payload = c.mon_command({"type": "get_map"})
-        assert payload["map"]["osd_weight"][1] == 0x8000
+        from ceph_tpu.osdmap.bincode_maps import payload_map
+        assert payload_map(payload).osd_weight[1] == 0x8000
     finally:
         c.shutdown()

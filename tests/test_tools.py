@@ -360,7 +360,8 @@ def test_ceph_cli(capsys):
                               "0.5"]) == 0
         capsys.readouterr()
         payload = c.mon_command({"type": "get_map"})
-        assert payload["map"]["osd_weight"][1] == 0x8000
+        from ceph_tpu.osdmap.bincode_maps import payload_map
+        assert payload_map(payload).osd_weight[1] == 0x8000
 
         # health returns nonzero on WARN
         c.kill_osd(2)

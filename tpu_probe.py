@@ -147,7 +147,7 @@ def worker_stages():
             f"spec/big10k/k{k_tries}/{mode}", _stage_spec, bench,
             "map_big10k", plat, k_tries, mode, batch, iters)
     bench._try_stage("gen/big10k", bench._stage_crush, "map_big10k",
-                     plat, batch=(1 << 17) if on else (1 << 13),
+                     plat, batch=(1 << 14) if on else (1 << 13),
                      iters=8 if on else 2)
     bench._try_stage("ec_pallas", _stage_pallas_ec, plat)
     bench._try_stage("ec/large", bench._stage_ec, plat,
