@@ -1,0 +1,16 @@
+"""Concurrency-correctness layer: lockdep, stall watchdog.
+
+The src/common/lockdep.cc + sanitizer-wiring role for a framework
+that is dozens of threads deep (messenger readers + dispatch pool,
+quorum ticks, scheduler workers, recovery, heartbeats): concurrency
+structure is CHECKED at runtime, not assumed.  The static half lives
+in tools/lint_concurrency.py.
+"""
+
+from .lockdep import (DLock, DRLock, enable, enabled, make_lock,
+                      make_rlock, violations)
+from .watchdog import Watchdog, dump_blocked, section, start_global
+
+__all__ = ["DLock", "DRLock", "enable", "enabled", "make_lock",
+           "make_rlock", "violations", "Watchdog", "dump_blocked",
+           "section", "start_global"]

@@ -30,6 +30,8 @@ class AdminSocket:
         self._sock: Optional[socket.socket] = None
         self._thread: Optional[threading.Thread] = None
         self._running = False
+        self.errors = 0  # serve-loop faults (see _serve)
+        self.last_error: Optional[str] = None
         self.register("help", lambda _a: dict(self._descs),
                       "list registered commands")
 
@@ -73,8 +75,13 @@ class AdminSocket:
                         data += got
                     reply = self._dispatch(data.decode() or "{}")
                     conn.sendall(reply.encode() + b"\n")
-            except Exception:
-                pass
+            except Exception as e:
+                # one bad client connection must not kill the serve
+                # loop — but never vanish silently either (the
+                # swallowed-thread-death lint class): keep the last
+                # error inspectable
+                self.errors += 1
+                self.last_error = repr(e)
 
     def _dispatch(self, line: str) -> str:
         try:
@@ -125,6 +132,17 @@ class AdminSocket:
 def wire_defaults(sock: AdminSocket, config=None, perf=None,
                   logcore=None) -> None:
     """Register the built-in command set every daemon exposes."""
+    from ..analysis.watchdog import dump_blocked
+
+    # the stall-watchdog surface (analysis/watchdog.py): locks held /
+    # handlers running past ?threshold seconds + all-thread stacks
+    sock.register(
+        "dump_blocked",
+        lambda a: dump_blocked(
+            threshold=float(a.get("threshold", 0.0)),
+            with_stacks=bool(a.get("stacks", True))),
+        "locks held and handlers stalled beyond a threshold, with "
+        "per-thread stacks")
     if perf is not None:
         sock.register("perf dump",
                       lambda a: perf.dump(a.get("logger")),

@@ -83,6 +83,14 @@ OPTIONS: Dict[str, Option] = _opts(
            "base retry window for monitor elections (rank-staggered)"),
     Option("bench_tpu_deadline", float, 300.0,
            "seconds before the bench abandons a hung backend"),
+    Option("lockdep", bool, False,
+           "runtime lock-order checking (analysis/lockdep.py); the "
+           "CEPH_TPU_LOCKDEP env var is the usual switch — this "
+           "option mirrors it for config-file-driven runs"),
+    Option("watchdog_threshold", float, 30.0,
+           "seconds a lock may stay held or a handler may run before "
+           "the stall watchdog dumps all-thread stacks "
+           "(analysis/watchdog.py; also the dump_blocked default)"),
 )
 
 

@@ -12,6 +12,10 @@ import os
 import tempfile
 from typing import Optional
 
+from ..analysis.lockdep import make_lock, make_rlock  # noqa: F401 —
+# the lock-registry hook: services build named, lockdep-tracked locks
+# through the context module (or ..analysis.lockdep directly); raw
+# threading.Lock() construction is flagged by tools/lint_concurrency.py
 from .admin_socket import AdminSocket, wire_defaults
 from .config import Config
 from .log import LogCore, SubsysLogger
@@ -19,11 +23,17 @@ from .perf_counters import PerfCountersCollection
 
 
 class Context:
+    make_lock = staticmethod(make_lock)
+    make_rlock = staticmethod(make_rlock)
     def __init__(self, name: str = "ceph-tpu",
                  config: Optional[Config] = None,
                  admin_dir: Optional[str] = None):
         self.name = name
         self.conf = config or Config()
+        if self.conf["lockdep"]:
+            from ..analysis import lockdep
+
+            lockdep.enable(True)
         self.log = LogCore(max_recent=self.conf["log_max_recent"])
         self.perf = PerfCountersCollection()
         self._admin: Optional[AdminSocket] = None
@@ -61,6 +71,12 @@ class Context:
             wire_defaults(self._admin, config=self.conf,
                           perf=self.perf, logcore=self.log)
             self._admin.start()
+            # a daemon with an admin plane gets the stall watchdog
+            # behind it: dump_blocked serves on demand, the scanner
+            # reports wedges unprompted
+            from ..analysis.watchdog import start_global
+
+            start_global(self.conf["watchdog_threshold"])
         return self._admin
 
     def shutdown(self) -> None:

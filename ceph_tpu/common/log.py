@@ -12,9 +12,10 @@ from __future__ import annotations
 
 import collections
 import sys
-import threading
 import time
 from typing import Deque, Dict, Optional, Tuple
+
+from ..analysis.lockdep import make_lock
 
 _Entry = Tuple[float, str, int, str]  # (stamp, subsys, level, message)
 
@@ -27,7 +28,7 @@ class LogCore:
         self.max_recent = max_recent
         self._recent: Deque[_Entry] = collections.deque(
             maxlen=max_recent)
-        self._lock = threading.Lock()
+        self._lock = make_lock("log::core")
         self.stream = stream if stream is not None else sys.stderr
 
     def set_level(self, subsys: str, level: int) -> None:

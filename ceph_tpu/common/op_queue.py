@@ -147,9 +147,11 @@ class OpScheduler:
                  n_workers: int = 2):
         import threading
 
+        from ..analysis.lockdep import make_lock
+
         # NOT `queue or ...`: an empty MClockQueue is len()==0 falsy
         self.q = queue if queue is not None else default_osd_queue()
-        self._cv = threading.Condition()
+        self._cv = threading.Condition(make_lock("opq::cv"))
         self._running = True
         self.served: Dict[str, int] = collections.defaultdict(int)
         self._workers = [
@@ -212,12 +214,19 @@ class OpScheduler:
             if job():
                 # bounded wait failed: back of the class queue (the
                 # job itself paces via its own wait timeout)
+                final = False
                 with self._cv:
                     if self._running:
                         self.q.enqueue(cls, job, _time.monotonic())
                         self._cv.notify()
                     else:
-                        job(final=True)
+                        final = True
+                if final:
+                    # OUTSIDE the cv, mirroring drain(): the final run
+                    # re-executes fn(), which can block on a PG-lock
+                    # wait or an fsync-heavy store write — holding the
+                    # cv through that stalls every worker and shutdown
+                    job(final=True)
 
     def depths(self) -> Dict[str, int]:
         with self._cv:

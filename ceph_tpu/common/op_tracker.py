@@ -11,9 +11,10 @@ slow.
 from __future__ import annotations
 
 import collections
-import threading
 import time
 from typing import Deque, Dict, List, Optional
+
+from ..analysis.lockdep import make_lock
 
 
 class TrackedOp:
@@ -60,7 +61,7 @@ class OpTracker:
         self._slow: Deque[TrackedOp] = collections.deque(
             maxlen=history_size)
         self.slow_threshold = history_slow_threshold
-        self._lock = threading.Lock()
+        self._lock = make_lock("optracker")
         self._served = 0
 
     def create(self, op_type: str, desc: str = "") -> TrackedOp:

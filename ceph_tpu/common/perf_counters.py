@@ -9,8 +9,9 @@ every collection (perf_counters.h:63-141 / PerfCountersCollection).
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional, Tuple
+
+from ..analysis.lockdep import make_lock
 
 U64 = "u64"          # monotonically increasing counter
 GAUGE = "gauge"      # settable level
@@ -26,7 +27,7 @@ class PerfCounters:
         self._values: Dict[str, float] = {}
         self._avgs: Dict[str, Tuple[int, float]] = {}
         self._hists: Dict[str, List[int]] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("perf::counters")
 
     # -- declaration (PerfCountersBuilder) ----------------------------
     def add_u64_counter(self, key: str, desc: str = "") -> None:
@@ -103,7 +104,7 @@ class PerfCountersCollection:
 
     def __init__(self):
         self._loggers: Dict[str, PerfCounters] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("perf::collection")
 
     def add(self, counters: PerfCounters) -> None:
         with self._lock:

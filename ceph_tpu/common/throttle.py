@@ -10,13 +10,16 @@ from __future__ import annotations
 
 import threading
 
+from ..analysis.lockdep import make_lock
+
 
 class Throttle:
     def __init__(self, name: str, max_: int):
         self.name = name
         self.max = max_
         self.current = 0
-        self._cond = threading.Condition()
+        self._cond = threading.Condition(
+            make_lock(f"throttle::{name}"))
 
     def get(self, count: int = 1, timeout: float | None = None) -> bool:
         """Block until the budget admits ``count``; False on timeout."""

@@ -15,8 +15,9 @@ from __future__ import annotations
 import ctypes
 import pathlib
 import subprocess
-import threading
 from typing import List, Optional, Tuple
+
+from ..analysis.lockdep import make_lock
 
 import numpy as np
 
@@ -27,7 +28,7 @@ REPO = pathlib.Path(__file__).resolve().parents[2]
 NATIVE_DIR = REPO / "native"
 LIB_PATH = NATIVE_DIR / "libcrush_host.so"
 
-_lock = threading.Lock()
+_lock = make_lock("crush::native_build")
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 

@@ -53,7 +53,12 @@ import struct
 import threading
 import time
 import uuid
+import zlib
 from typing import Callable, Dict, Optional, Tuple
+
+from ..analysis import watchdog
+from ..analysis.lockdep import make_lock, make_rlock
+from ..common.log import getLogger
 
 Addr = Tuple[str, int]
 Handler = Callable[[Dict], Optional[Dict]]
@@ -61,8 +66,8 @@ Handler = Callable[[Dict], Optional[Dict]]
 # per-socket send locks: sendall() on a large frame loops, so two
 # threads writing the same cached connection would interleave bytes
 # and corrupt the framing
-_send_locks: Dict[int, threading.Lock] = {}
-_send_locks_guard = threading.Lock()
+_send_locks: Dict[int, object] = {}
+_send_locks_guard = make_lock("msgr::send_guard")
 
 _UNACKED_CAP = 512      # frames buffered per lossless peer session
 _REPLY_CACHE_CAP = 128  # replies cached per remote session
@@ -75,15 +80,26 @@ _FRAME_V = 2        # frame format version byte
 _FL_ZLIB = 0x01     # control segment is zlib-compressed
 
 _BLOB_KEY = "__frame_blob__"
+_ESC_KEY = "__frame_esc__"
+
+# blob-table sanity ceiling: nothing legitimate ships this many data
+# segments in one frame, and a forged count must not allocate first
+_MAX_BLOBS = 1 << 16
 
 
 def _lift_blobs(obj, blobs: list):
     """Replace every bytes-like value with a data-segment reference —
-    the front/data split of the reference's Message bufferlists."""
+    the front/data split of the reference's Message bufferlists.  A
+    LITERAL single-key dict that collides with either wire sentinel is
+    escaped so _restore_blobs hands it back verbatim instead of
+    resolving it into an unrelated data segment."""
     if isinstance(obj, (bytes, bytearray, memoryview)):
         blobs.append(bytes(obj))
         return {_BLOB_KEY: len(blobs) - 1}
     if isinstance(obj, dict):
+        if len(obj) == 1 and next(iter(obj)) in (_BLOB_KEY, _ESC_KEY):
+            return {_ESC_KEY: {k: _lift_blobs(v, blobs)
+                               for k, v in obj.items()}}
         return {k: _lift_blobs(v, blobs) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         return [_lift_blobs(v, blobs) for v in obj]
@@ -93,7 +109,17 @@ def _lift_blobs(obj, blobs: list):
 def _restore_blobs(obj, blobs: list):
     if isinstance(obj, dict):
         if len(obj) == 1 and _BLOB_KEY in obj:
-            return blobs[obj[_BLOB_KEY]]
+            idx = obj[_BLOB_KEY]
+            if not isinstance(idx, int) or not 0 <= idx < len(blobs):
+                raise ValueError(f"blob index {idx!r} out of range "
+                                 f"(frame has {len(blobs)})")
+            return blobs[idx]
+        if len(obj) == 1 and _ESC_KEY in obj:
+            inner = obj[_ESC_KEY]
+            if not isinstance(inner, dict):
+                raise ValueError("malformed sentinel escape")
+            return {k: _restore_blobs(v, blobs)
+                    for k, v in inner.items()}
         return {k: _restore_blobs(v, blobs) for k, v in obj.items()}
     if isinstance(obj, list):
         return [_restore_blobs(v, blobs) for v in obj]
@@ -101,8 +127,6 @@ def _restore_blobs(obj, blobs: list):
 
 
 def _send_frame(sock: socket.socket, msg: Dict, keyring=None) -> None:
-    import zlib
-
     blobs: list = []
     jmsg = _lift_blobs(msg, blobs)
     if keyring is not None:
@@ -120,7 +144,9 @@ def _send_frame(sock: socket.socket, msg: Dict, keyring=None) -> None:
         parts.append(b)
     payload = b"".join(parts)
     with _send_locks_guard:
-        lock = _send_locks.setdefault(id(sock), threading.Lock())
+        lock = _send_locks.get(id(sock))
+        if lock is None:
+            lock = _send_locks[id(sock)] = make_lock("msgr::send")
     with lock:
         sock.sendall(struct.pack(">I", len(payload)) + payload)
 
@@ -138,9 +164,10 @@ def _recv_exact(sock: socket.socket, n: int):
 def _recv_frame(sock: socket.socket):
     """Returns (msg, blobs, nbytes) or None on EOF.  ``msg`` still
     holds data-segment references; the dispatcher restores them after
-    MAC verification."""
-    import zlib
-
+    MAC verification.  Every length field is bounds-checked against
+    the frame (raising ValueError): a truncated or forged blob table
+    must be a clean protocol error, never an uncaught struct.error
+    that kills the reader thread with its cleanup skipped."""
     header = _recv_exact(sock, 4)
     if header is None:
         return None
@@ -148,20 +175,31 @@ def _recv_frame(sock: socket.socket):
     payload = _recv_exact(sock, length)
     if payload is None:
         return None
+    if len(payload) < 6:
+        raise ValueError(f"frame too short ({len(payload)} bytes)")
     ver, flags, jlen = struct.unpack_from("<BBI", payload, 0)
     if ver != _FRAME_V:
         raise ValueError(f"unknown frame version {ver}")
     pos = 6
+    if pos + jlen + 4 > len(payload):
+        raise ValueError("truncated control segment")
     body = payload[pos:pos + jlen]
     pos += jlen
     if flags & _FL_ZLIB:
         body = zlib.decompress(body)
     (nblobs,) = struct.unpack_from("<I", payload, pos)
     pos += 4
+    if nblobs > _MAX_BLOBS or nblobs * 4 > len(payload) - pos:
+        raise ValueError(f"blob table oversized ({nblobs} entries in "
+                         f"{len(payload) - pos} bytes)")
     blobs = []
     for _ in range(nblobs):
+        if pos + 4 > len(payload):
+            raise ValueError("truncated blob table")
         (blen,) = struct.unpack_from("<I", payload, pos)
         pos += 4
+        if pos + blen > len(payload):
+            raise ValueError("truncated blob")
         blobs.append(payload[pos:pos + blen])
         pos += blen
     return json.loads(body.decode()), blobs, length
@@ -171,16 +209,23 @@ class _OutSession:
     """Sender-side lossless state for one peer address."""
 
     def __init__(self):
-        self.lock = threading.RLock()  # serializes seq assignment,
-        # handshake, and transmission → frames hit the wire in order
+        self.lock = make_rlock("msgr::out_session")  # serializes seq
+        # assignment, handshake, and transmission → frames hit the
+        # wire in order
         # buf_lock guards ONLY the unacked buffer: acks arrive on
         # reader threads and must trim without waiting on a handshake
         # in progress (which itself waits on that reader — deadlock)
-        self.buf_lock = threading.Lock()
+        self.buf_lock = make_lock("msgr::out_buf")
         self.out_seq = 0
         self.unacked: "collections.OrderedDict[int, Dict]" = \
             collections.OrderedDict()
         self.synced = False  # handshake done on the current conn
+        # tids of calls in flight on this session (guarded by
+        # buf_lock): when the background resync gives the peer up,
+        # these waiters are failed IMMEDIATELY instead of burning
+        # their full timeout against a dead daemon — the stall that
+        # held a primary's PG lock for 10s per push during thrash
+        self.waiters: set = set()
 
     def trim(self, upto: int) -> None:
         """Transport-level ack: drops fire-and-forget frames only.  A
@@ -214,12 +259,21 @@ class _OutSession:
 
 
 class _InSession:
-    """Receiver-side dedup state for one remote (name, session)."""
+    """Receiver-side dedup state for one remote (name, session).
+
+    ``fifo``/``draining`` implement the per-session serial dispatch
+    lane: sequenced lossless frames from one peer session execute in
+    arrival order (one lane worker at a time) while different sessions
+    still share the dispatch pool concurrently — the reference's
+    per-connection DispatchQueue ordering, which the quorum layer
+    needs (mon_accept(v+1) must not overtake mon_commit(v))."""
 
     def __init__(self):
         self.in_seq = 0
         self.replies: "collections.OrderedDict[int, Dict]" = \
             collections.OrderedDict()
+        self.fifo: "collections.deque" = collections.deque()
+        self.draining = False
 
     def cache_reply(self, seq: int, frame: Dict) -> None:
         self.replies[seq] = frame
@@ -232,11 +286,13 @@ class Messenger:
                  port: int = 0, keyring=None, lossless: bool = False,
                  throttles: Optional[Dict[str, object]] = None):
         self.name = name
+        self.log = getLogger("msgr")
         self.keyring = keyring  # cephx-style frame auth when set
         self.lossless = lossless
         self.session_id = uuid.uuid4().hex[:16]
         self.throttles = throttles or {}
         self._handlers: Dict[str, Handler] = {}
+        self._ordered: set = set()  # types on the serial lane
         self._listener = socket.socket(socket.AF_INET,
                                        socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET,
@@ -246,24 +302,49 @@ class Messenger:
         self._listener.settimeout(0.2)
         self.addr: Addr = self._listener.getsockname()
         self._running = False
+        self._shut = False  # terminal: no reconnects past shutdown()
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: Dict[Addr, socket.socket] = {}
-        self._conn_lock = threading.Lock()
+        # accept-side sockets, so shutdown can close them and their
+        # reader threads exit promptly instead of lingering blocked in
+        # recv until the remote end dies (cross-test thread leakage)
+        self._accepted: set = set()
+        self._conn_lock = make_lock("msgr::conn")
         self._out: Dict[Addr, _OutSession] = {}
         self._in: Dict[Tuple[str, str], _InSession] = {}
-        self._in_lock = threading.Lock()
+        self._in_lock = make_lock("msgr::in")
         self._pending: Dict[str, Dict] = {}
         self._waiting: set = set()  # tids with a live waiter
-        self._pending_cv = threading.Condition()
+        # id(conn) -> tids of CONN-BOUND calls (lossy calls and the
+        # __hello__ handshake — no session replay behind them): when
+        # the conn's reader exits these fail immediately instead of
+        # burning their full timeout against a dead peer.  A client
+        # put() once waited 20s on an OSD killed mid-call, and a
+        # resync handshake waited 5s holding the session lock.
+        self._conn_waiters: Dict[int, set] = {}
+        self._pending_cv = threading.Condition(
+            make_lock("msgr::pending"))
         # lazy dispatch pool (DispatchQueue role); created on first
         # inbound op so pure clients never spawn it
         self._pool = None
-        self._pool_lock = threading.Lock()
+        self._pool_lock = make_lock("msgr::pool")
 
     # -- dispatch ------------------------------------------------------
-    def register(self, type_: str, handler: Handler) -> None:
-        """Handler returns a reply dict (routed back by tid) or None."""
+    def register(self, type_: str, handler: Handler,
+                 ordered: bool = False) -> None:
+        """Handler returns a reply dict (routed back by tid) or None.
+
+        ``ordered=True`` puts the type on the per-session serial lane:
+        sequenced frames of ordered types from one peer session run in
+        arrival order relative to EACH OTHER (the reference's ordered
+        DispatchQueue), which state machines like the quorum need —
+        mon_accept(v+1) must not overtake mon_commit(v).  Unordered
+        types keep full fast-dispatch parallelism (the reference's
+        ms_fast_dispatch), so a store op blocking in the scheduler
+        can never head-of-line-block a session's control traffic."""
         self._handlers[type_] = handler
+        if ordered:
+            self._ordered.add(type_)
 
     def start(self) -> None:
         self._running = True
@@ -285,8 +366,11 @@ class Messenger:
                 continue
             except OSError:
                 break
+            with self._conn_lock:
+                self._accepted.add(conn)
             threading.Thread(target=self._reader, args=(conn, None),
-                             daemon=True).start()
+                             daemon=True,
+                             name=f"msgr-rd:{self.name}").start()
 
     def _reader(self, conn: socket.socket, addr: Optional[Addr]) -> None:
         """``addr`` set = a client-initiated connection we own; its
@@ -295,14 +379,34 @@ class Messenger:
             while self._running:
                 try:
                     got = _recv_frame(conn)
-                except (OSError, ValueError):
+                except (OSError, ValueError, struct.error,
+                        zlib.error):
                     break  # closed or corrupt frame: drop the session
                 if got is None:
                     break
                 msg, blobs, nbytes = got
-                self._dispatch(conn, msg, blobs, nbytes)
+                try:
+                    self._dispatch(conn, msg, blobs, nbytes)
+                except Exception as e:
+                    # a poisoned frame (bad blob reference, malformed
+                    # control fields) drops THAT frame; the reader —
+                    # and with it the session's resync/cleanup path —
+                    # must survive it
+                    self.log.derr(f"{self.name}: dropping bad frame "
+                                  f"({msg.get('type')!r}): {e!r}")
         with _send_locks_guard:
             _send_locks.pop(id(conn), None)
+        with self._conn_lock:
+            self._accepted.discard(conn)
+            tids = self._conn_waiters.pop(id(conn), set())
+        if tids:
+            with self._pending_cv:
+                for tid in tids:
+                    if tid in self._waiting and \
+                            tid not in self._pending:
+                        self._pending[tid] = {
+                            "__session_dead__": "connection lost"}
+                self._pending_cv.notify_all()
         if addr is not None:
             self._on_conn_death(addr, conn)
 
@@ -320,7 +424,11 @@ class Messenger:
                                  daemon=True).start()
 
     def _resync(self, addr: Addr) -> None:
-        """Reconnect + replay after a dropped lossless connection."""
+        """Reconnect + replay after a dropped lossless connection.
+        When every attempt fails the peer is presumed dead: calls
+        still waiting on this session fail NOW (their frames stay
+        buffered — a later reconnect replays them and dedup keeps
+        exactly-once execution)."""
         for attempt in range(5):
             if not self._running:
                 return
@@ -330,6 +438,22 @@ class Messenger:
                 return
             except (OSError, TimeoutError):
                 time.sleep(0.1 * (attempt + 1))
+        self._fail_waiters(addr, "peer unreachable after resync")
+
+    def _fail_waiters(self, addr: Addr, why: str) -> None:
+        sess = self._out.get(tuple(addr))
+        if sess is None:
+            return
+        with sess.buf_lock:
+            tids = list(sess.waiters)
+            sess.waiters.clear()
+        if not tids:
+            return
+        with self._pending_cv:
+            for tid in tids:
+                if tid in self._waiting and tid not in self._pending:
+                    self._pending[tid] = {"__session_dead__": why}
+            self._pending_cv.notify_all()
 
     def _send(self, conn: socket.socket, msg: Dict) -> None:
         """Sign-at-wire-time send: frames are stored/buffered unsigned
@@ -388,11 +512,45 @@ class Messenger:
         # src/msg/DispatchQueue.h): one connection can have many ops
         # in flight — without this, a primary fanning a write out to
         # replicas serializes every other op sharing the connection
-        # behind the fan-out's round trips.  Sequencing/dedup stays on
-        # the reader (above): in_seq is final by now; per-object order
-        # is owned by PG locks + versions, as in the reference's
-        # sharded op queues.
-        self._pool_submit(self._handle, conn, msg, ins, seq, nbytes)
+        # behind the fan-out's round trips.  Sequenced frames of
+        # ORDERED types additionally keep per-session FIFO through a
+        # serial lane feeding the pool (below): the quorum layer
+        # relies on mon_commit(v) finishing before mon_accept(v+1)
+        # starts, and two pool workers racing frames from one peer
+        # broke that (spurious non-contiguous nacks → leader
+        # abdication churn).  Everything else stays fully parallel;
+        # per-object order there is owned by PG locks + versions, as
+        # in the reference's sharded op queues.
+        if ins is not None and type_ in self._ordered:
+            with self._in_lock:
+                ins.fifo.append((conn, msg, seq, nbytes))
+                drain = not ins.draining
+                if drain:
+                    ins.draining = True
+            if drain:
+                self._pool_submit(self._drain_session, ins)
+        else:
+            self._pool_submit(self._handle, conn, msg, ins, seq,
+                              nbytes)
+
+    def _drain_session(self, ins: _InSession) -> None:
+        """Serial lane worker: run one session's queued frames in
+        arrival order, then retire.  At most one lane worker per
+        session exists (the ``draining`` flag, flipped under
+        _in_lock), so frames never reorder within a session."""
+        while True:
+            with self._in_lock:
+                if not ins.fifo:
+                    ins.draining = False
+                    return
+                conn, msg, seq, nbytes = ins.fifo.popleft()
+            try:
+                self._handle(conn, msg, ins, seq, nbytes)
+            except Exception as e:
+                # the lane must survive a poisoned op, or every later
+                # frame from this session queues forever
+                self.log.derr(f"{self.name}: handler for "
+                              f"{msg.get('type')!r} died: {e!r}")
 
     def _resend_cached(self, conn, ins: _InSession, seq: int) -> None:
         deadline = time.monotonic() + 2.0
@@ -437,10 +595,13 @@ class Messenger:
             if handler is None:
                 reply = {"error": f"no handler for {type_!r}"}
             else:
-                try:
-                    reply = handler(msg)
-                except Exception as e:
-                    reply = {"error": str(e)}
+                # watchdog-visible: a handler wedged on a lock or a
+                # peer RPC shows up in dump_blocked with its stack
+                with watchdog.section(f"{self.name}:{type_}"):
+                    try:
+                        reply = handler(msg)
+                    except Exception as e:
+                        reply = {"error": str(e)}
         finally:
             if throttle is not None:
                 throttle.put(nbytes)
@@ -479,6 +640,12 @@ class Messenger:
     def _connect(self, addr: Addr) -> socket.socket:
         addr = tuple(addr)
         with self._conn_lock:
+            if self._shut:
+                # a background resync racing shutdown() must not dial
+                # a fresh connection: it lands AFTER the conn table is
+                # cleared, nothing ever closes it, and its reader
+                # thread leaks into the next test/runtime
+                raise OSError(f"{self.name}: messenger shut down")
             sock = self._conns.get(addr)
             if sock is not None:
                 return sock
@@ -487,17 +654,31 @@ class Messenger:
                             socket.TCP_NODELAY, 1)
             self._conns[addr] = sock
             threading.Thread(target=self._reader, args=(sock, addr),
-                             daemon=True).start()
+                             daemon=True,
+                             name=f"msgr-rd:{self.name}").start()
             return sock
+
+    @staticmethod
+    def _hard_close(sock: socket.socket) -> None:
+        """shutdown(2) then close: a plain close() is DEFERRED by
+        CPython while another thread sits in recv() on the same socket
+        object (_io_refs), so the reader would stay blocked on an fd
+        nobody can close anymore; SHUT_RDWR tears the connection down
+        regardless and wakes the reader with EOF."""
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
 
     def _drop(self, addr: Addr) -> None:
         with self._conn_lock:
             sock = self._conns.pop(tuple(addr), None)
         if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
+            self._hard_close(sock)
 
     def _session(self, addr: Addr) -> _OutSession:
         addr = tuple(addr)
@@ -515,8 +696,11 @@ class Messenger:
         deadline = time.monotonic() + timeout
         with self._pending_cv:
             self._waiting.add(tid)
+        sock = None
         try:
-            self._send(self._connect(addr), msg)
+            sock = self._connect(addr)
+            self._bind_waiter(sock, tid)
+            self._send(sock, msg)
             with self._pending_cv:
                 while tid not in self._pending:
                     remaining = deadline - time.monotonic()
@@ -526,34 +710,71 @@ class Messenger:
                             raise TimeoutError(
                                 f"{self.name}: no hello reply from "
                                 f"{addr}")
-                return self._pending.pop(tid)
+                rep = self._pending.pop(tid)
+            if isinstance(rep, dict) and "__session_dead__" in rep:
+                raise OSError(f"{self.name}: {addr} "
+                              f"{rep['__session_dead__']}")
+            return rep
         finally:
+            if sock is not None:
+                self._unbind_waiter(sock, tid)
             with self._pending_cv:
                 self._waiting.discard(tid)
                 self._pending.pop(tid, None)
 
-    def _ensure_synced(self, addr: Addr) -> None:
+    def _bind_waiter(self, sock, tid: str) -> None:
+        with self._conn_lock:
+            self._conn_waiters.setdefault(id(sock), set()).add(tid)
+
+    def _unbind_waiter(self, sock, tid: str) -> None:
+        with self._conn_lock:
+            tids = self._conn_waiters.get(id(sock))
+            if tids is not None:
+                tids.discard(tid)
+                if not tids:
+                    del self._conn_waiters[id(sock)]
+
+    def _ensure_synced(self, addr: Addr,
+                       deadline: Optional[float] = None) -> None:
         """Under the session lock: connect, handshake, replay the
         unacked tail past the peer's in_seq (ProtocolV2 reconnect).
         Replays every buffered frame, so callers must NOT also send
-        frames buffered before this ran."""
+        frames buffered before this ran.  The handshake honors the
+        caller's ``deadline``: connect() can succeed into a dying
+        peer's accept backlog and then never see a reply, and a
+        5-second wait there — under the session lock — once starved a
+        leader's lease round long enough to collapse the quorum."""
         sess = self._session(addr)
         sock = self._connect(addr)
         if sess.synced:
             return
+        timeout = 5.0 if deadline is None else \
+            max(0.05, min(5.0, deadline - time.monotonic()))
         rep = self._raw_call(addr, {"type": "__hello__",
                                     "sess": self.session_id},
-                             timeout=5.0)
+                             timeout=timeout)
         peer_in = int(rep.get("in_seq", 0))
         sess.trim(peer_in)
         for frame in sess.pending():
             self._send(sock, frame)
         sess.synced = True
 
-    def _send_sequenced(self, addr: Addr, msg: Dict) -> int:
-        """Returns the assigned seq (call() completes it on reply)."""
+    def _send_sequenced(self, addr: Addr, msg: Dict,
+                        timeout: float = 5.0) -> int:
+        """Returns the assigned seq (call() completes it on reply).
+
+        Bounded end to end by ``timeout``: the session lock may be
+        held for seconds by a background resync handshaking with a
+        dead peer, and a caller with its own small deadline (a lease
+        round, a heartbeat) must fail fast rather than queue behind
+        it — the quorum-collapse class the lockdep/watchdog layer
+        exists to catch."""
         sess = self._session(addr)
-        with sess.lock:
+        deadline = time.monotonic() + timeout
+        if not sess.lock.acquire(timeout=timeout):
+            raise TimeoutError(f"{self.name}: session to {addr} busy "
+                               f"(resync in progress)")
+        try:
             sess.out_seq += 1
             seq = sess.out_seq
             frame = dict(msg, _s=seq, _sess=self.session_id,
@@ -563,14 +784,15 @@ class Messenger:
                 if sess.synced:
                     self._send(self._connect(addr), frame)
                 else:
-                    self._ensure_synced(addr)  # replays incl. frame
+                    self._ensure_synced(addr, deadline)  # replays
+                    # every buffered frame, this one included
             except (OSError, TimeoutError):
                 # one immediate retry on a fresh connection; further
                 # healing happens in the background resync
                 self._drop(addr)
                 sess.synced = False
                 try:
-                    self._ensure_synced(addr)
+                    self._ensure_synced(addr, deadline)
                 except (OSError, TimeoutError):
                     if msg.get("tid") is not None:
                         # the call is failing to its caller: a frame
@@ -579,13 +801,18 @@ class Messenger:
                         sess.complete(seq)
                     raise
             return seq
+        finally:
+            sess.lock.release()
 
     def send(self, addr: Addr, msg: Dict) -> None:
         """Fire-and-forget.  Lossless: sequenced + replayed across
         reconnects.  Lossy: one silent reconnect attempt."""
         if self.lossless:
             try:
-                self._send_sequenced(addr, msg)
+                # bounded: a fire-and-forget caller (heartbeat loop,
+                # map pusher) must not wedge behind a dead session's
+                # resync; the unacked buffer owns delivery anyway
+                self._send_sequenced(addr, msg, timeout=2.0)
             except (OSError, TimeoutError):
                 pass  # unacked buffer + resync own the retry
             return
@@ -605,20 +832,30 @@ class Messenger:
         tid = uuid.uuid4().hex
         deadline = time.monotonic() + timeout
         seq = None
+        sock = None
+        sess = self._session(addr) if self.lossless else None
         with self._pending_cv:
             self._waiting.add(tid)
         try:
             if self.lossless:
-                seq = self._send_sequenced(addr, dict(msg, tid=tid))
+                with sess.buf_lock:
+                    sess.waiters.add(tid)
+                seq = self._send_sequenced(addr, dict(msg, tid=tid),
+                                           timeout=timeout)
             else:
                 smsg = dict(msg, tid=tid, frm=self.name)
                 try:
-                    self._send(self._connect(addr), smsg)
+                    sock = self._connect(addr)
+                    self._send(sock, smsg)
                 except OSError:
                     # stale cached connection (peer restarted): one
                     # fresh reconnect before giving up
                     self._drop(addr)
-                    self._send(self._connect(addr), smsg)
+                    sock = self._connect(addr)
+                    self._send(sock, smsg)
+                # lossy: no replay behind this call — it dies with
+                # its connection instead of waiting out the timeout
+                self._bind_waiter(sock, tid)
             with self._pending_cv:
                 while tid not in self._pending:
                     remaining = deadline - time.monotonic()
@@ -628,7 +865,12 @@ class Messenger:
                             raise TimeoutError(
                                 f"{self.name}: no reply from {addr} "
                                 f"for {msg['type']}")
-                return self._pending.pop(tid)
+                rep = self._pending.pop(tid)
+            if isinstance(rep, dict) and "__session_dead__" in rep:
+                # resync gave the peer up: fail now, not at timeout
+                raise OSError(f"{self.name}: {addr} "
+                              f"{rep['__session_dead__']}")
+            return rep
         except OSError:
             self._drop(addr)
             raise
@@ -637,11 +879,17 @@ class Messenger:
                 # replied, timed out, or failed: either way this call
                 # is over — stop replaying its request
                 self._session(addr).complete(seq)
+            if sess is not None:
+                with sess.buf_lock:
+                    sess.waiters.discard(tid)
+            if sock is not None:
+                self._unbind_waiter(sock, tid)
             with self._pending_cv:
                 self._waiting.discard(tid)
                 self._pending.pop(tid, None)
 
     def shutdown(self) -> None:
+        self._shut = True
         self._running = False
         with self._pool_lock:
             pool, self._pool = self._pool, None
@@ -652,9 +900,8 @@ class Messenger:
         except OSError:
             pass
         with self._conn_lock:
-            for sock in self._conns.values():
-                try:
-                    sock.close()
-                except OSError:
-                    pass
+            socks = list(self._conns.values()) + list(self._accepted)
             self._conns.clear()
+            self._accepted.clear()
+        for sock in socks:
+            self._hard_close(sock)

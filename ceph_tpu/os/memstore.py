@@ -14,9 +14,9 @@ superblock+journal stand-in.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional
 
+from ..analysis.lockdep import make_rlock
 from .objectstore import (ObjectStore, Transaction, OP_CLONE, OP_MKCOLL,
                           OP_OMAP_CLEAR, OP_OMAP_RMKEYS,
                           OP_OMAP_SETKEYS, OP_REMOVE, OP_RMATTR,
@@ -47,7 +47,7 @@ class TransactionError(Exception):
 class MemStore(ObjectStore):
     def __init__(self):
         self._coll: Dict[str, Dict[str, _Object]] = {}
-        self._lock = threading.RLock()
+        self._lock = make_rlock("os::mem")
 
     # -- transaction application --------------------------------------
     def queue_transaction(self, txn: Transaction) -> None:

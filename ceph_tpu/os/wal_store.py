@@ -29,9 +29,9 @@ from __future__ import annotations
 
 import os
 import struct
-import threading
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis.lockdep import make_rlock
 from ..common.bincode import (DecodeError, Decoder, Encoder, decode_txn,
                               encode_txn)
 from .memstore import MemStore, _Object
@@ -89,7 +89,7 @@ class WALStore(ObjectStore):
         self._wal_bytes = 0
         self._ckpt_every = checkpoint_every_bytes
         self._sync = sync
-        self._lock = threading.RLock()
+        self._lock = make_rlock("os::wal")
 
     # -- lifecycle ----------------------------------------------------
     def mkfs(self) -> None:
@@ -115,7 +115,7 @@ class WALStore(ObjectStore):
                 with open(self._wal_path, "r+b") as f:
                     f.truncate(valid_end)
                     f.flush()
-                    os.fsync(f.fileno())
+                    os.fsync(f.fileno())  # conc-ok: mount-time only; nothing else can hold the store yet
             self._wal_f = open(self._wal_path, "ab")
             self._wal_bytes = self._wal_f.tell()
 
@@ -149,7 +149,7 @@ class WALStore(ObjectStore):
                 self._wal_f.write(rec)
                 self._wal_f.flush()
                 if self._sync:
-                    os.fsync(self._wal_f.fileno())
+                    os.fsync(self._wal_f.fileno())  # conc-ok: the fsync IS the txn ack point and the store lock IS the journal order (callers serialize at the PG, not here)
             except Exception:
                 # the append may have partially landed (buffered bytes,
                 # EIO mid-fsync).  Roll the log back to the last valid
@@ -194,7 +194,7 @@ class WALStore(ObjectStore):
             # records with seq <= ckpt seq; the seq check skips them
             self._wal_f = open(self._wal_path, "wb")
             if self._sync:
-                os.fsync(self._wal_f.fileno())
+                os.fsync(self._wal_f.fileno())  # conc-ok: checkpoint must be atomic vs writers; the lock is the barrier
             self._wal_bytes = 0
 
     def _write_checkpoint(self, seq: int) -> None:

@@ -18,6 +18,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..analysis.lockdep import make_rlock
 from ..common.version import make_version
 from ..msg.messenger import Addr, Messenger
 from ..osdmap.osdmap import OSDMap, POOL_TYPE_ERASURE
@@ -61,7 +62,7 @@ class Client(MapFollower):
         self.osd_addrs: Dict[int, Addr] = {}
         self.ec_profiles: Dict[str, Dict[str, str]] = {}
         self._codes: Dict[str, object] = {}
-        self._lock = threading.RLock()
+        self._lock = make_rlock("client::state")
         self._install_map(self.subscribe_all(f"client.{name}"))
 
     def shutdown(self) -> None:
@@ -354,7 +355,8 @@ class Client(MapFollower):
                     got = self.msgr.call(
                         self.osd_addrs[osd],
                         {"type": "obj_delete", "pool": pool_id,
-                         "ps": ps, "oid": oid, "v": v}, timeout=10)
+                         "ps": ps, "oid": oid, "v": v,
+                         "restamp": True}, timeout=10)
                     if not got.get("ok"):
                         raise OSError(f"obj_delete on osd.{osd}: "
                                       f"{got}")

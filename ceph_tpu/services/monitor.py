@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis.lockdep import make_lock, make_rlock
 from ..common.context import Context
 from ..msg.messenger import Addr, Messenger
 from ..osdmap.osdmap import OSDMap, PgPool
@@ -59,8 +60,8 @@ class Monitor:
         self._auto_out: Dict[int, int] = {}
         self._subscribers: Dict[str, Addr] = {}
         self._pushers: Dict[str, "_SubPusher"] = {}
-        self._lock = threading.RLock()
-        self._commit_serial = threading.Lock()
+        self._lock = make_rlock("mon::state")
+        self._commit_serial = make_lock("mon::commit")
         self._committed_epoch = 0
         self._ticker: Optional[threading.Thread] = None
         self._running = False

@@ -36,6 +36,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
+from ..analysis.lockdep import make_lock, make_rlock
 from ..common.context import Context
 from ..common.throttle import Throttle
 from ..ec.registry import profile_factory
@@ -83,7 +84,7 @@ class OSDService(MapFollower):
         self.osd_addrs: Dict[int, Addr] = {}
         self.ec_profiles: Dict[str, Dict[str, str]] = {}
         self._codes: Dict[str, object] = {}
-        self._lock = threading.RLock()
+        self._lock = make_rlock("osd::state")
         self._running = False
         self._beat_thread: Optional[threading.Thread] = None
         self._recover_thread: Optional[threading.Thread] = None
@@ -92,9 +93,12 @@ class OSDService(MapFollower):
             "backfill", ctx.conf["osd_max_backfills"])
         # per-PG serialization: RMW coordination AND the local
         # check-then-write path (reentrant: the RMW coordinator's
-        # self-push re-enters its own PG lock)
-        self._pg_locks: Dict[Tuple[int, int], threading.RLock] = {}
-        self._pg_locks_guard = threading.Lock()
+        # self-push re-enters its own PG lock).  All PG locks share
+        # the "osd::pg" lockdep node: cross-PG nesting on one thread
+        # never happens (a PG has one primary; pushes to OTHER PGs go
+        # over the wire), so same-name nesting stays un-edged
+        self._pg_locks: Dict[Tuple[int, int], object] = {}
+        self._pg_locks_guard = make_lock("osd::pg_guard")
         from ..common.op_queue import OpScheduler
         from ..common.op_tracker import OpTracker
 
@@ -334,6 +338,15 @@ class OSDService(MapFollower):
 
         cid = pg_cid(msg["pool"], msg["ps"])
         v = msg.get("v") or make_version(self.epoch)
+        if msg.get("restamp"):
+            # CLIENT deletes re-stamp at this daemon's current epoch
+            # (interval floor, like the write paths) so the tombstone
+            # dominates any version a currently-down holder minted in
+            # an earlier interval.  Peering-driven deletes propagate
+            # an exact authoritative version and must NOT be raised.
+            now_v = make_version(self.epoch)
+            if v < now_v:
+                v = now_v
         with self._pg_lock(msg["pool"], msg["ps"]):
             txn = Transaction()
             if not self.store.collection_exists(cid):
@@ -396,10 +409,13 @@ class OSDService(MapFollower):
         finally:
             lk.release()
 
-    def _pg_lock(self, pool_id: int, ps: int) -> threading.RLock:
+    def _pg_lock(self, pool_id: int, ps: int):
         with self._pg_locks_guard:
-            return self._pg_locks.setdefault((pool_id, ps),
-                                             threading.RLock())
+            lk = self._pg_locks.get((pool_id, ps))
+            if lk is None:
+                lk = self._pg_locks[(pool_id, ps)] = \
+                    make_rlock("osd::pg")
+            return lk
 
     def _h_ec_write(self, msg: Dict) -> Dict:
         # the RMW coordinator is control logic, NOT a store op: running
@@ -461,6 +477,16 @@ class OSDService(MapFollower):
 
         with self._pg_lock(pool_id, ps):
             v = msg.get("v") or make_version(self.epoch)
+            # the serving primary's epoch is the PG's interval
+            # authority (the reference stamps eversion_t at the
+            # primary): a client proposing a stale-epoch version must
+            # never mint one that loses to data already written in a
+            # newer interval whose holders happen to be down right
+            # now — that acks a write which a later revive+peering
+            # pass silently rolls back (thrash acked-write loss)
+            now_v = make_version(self.epoch)
+            if v < now_v:
+                v = now_v
             cid = pg_cid(pool_id, ps)
             curb = self.store.getattr(cid, f"{oid}.s0", "v") \
                 if self.store.collection_exists(cid) else None
@@ -482,7 +508,7 @@ class OSDService(MapFollower):
                 push(self.id)  # local write on this thread
                 for f in futs:
                     try:
-                        f.result(timeout=15)
+                        f.result(timeout=8)
                     except Exception:
                         pass
                 landed, newest = 0, None
@@ -548,6 +574,12 @@ class OSDService(MapFollower):
                 buf[:len(base)] = base
                 buf[offset:offset + len(data)] = data
             v = msg.get("v") or make_version(self.epoch)
+            # primary-epoch floor, as in the replicated path: a
+            # stale-epoch client proposal must not undercut versions
+            # minted in a newer interval (down-holder rollback class)
+            now_v = make_version(self.epoch)
+            if v < now_v:
+                v = now_v
             # PRIMARY-side version floor: the stamped version must
             # exceed what is stored, or a client with a lagging clock
             # writes a version that loses last-writer-wins to data it
@@ -1482,8 +1514,12 @@ class OSDService(MapFollower):
                 # the RMW coordinator — re-submitting would deadlock
                 # the worker pool
                 return self._do_shard_write(msg)
+            # 5s: long enough for a loaded replica's fsync+queue, but
+            # a push often runs under the PG lock, so a dead peer
+            # must stop blocking the whole PG quickly (the messenger
+            # fails even faster once its resync gives the peer up)
             return self.msgr.call(self.osd_addrs[osd], msg,
-                                  timeout=10)
+                                  timeout=5)
         except (TimeoutError, OSError):
             return None
 

@@ -57,6 +57,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis.lockdep import make_rlock
+
 Addr = Tuple[str, int]
 
 PROBING = "probing"
@@ -92,17 +94,23 @@ class Quorum:
         # same version): rank we acked at election_epoch, or None
         self.promised_rank: Optional[int] = None
         self._lease_fetching = False
-        self._lock = threading.RLock()
+        self._lock = make_rlock("quorum::state")
         self._running = False
         self._thread: Optional[threading.Thread] = None
 
+        # ordered=True: quorum messages from one peer must execute in
+        # arrival order — a mon_accept(v+1) racing ahead of its
+        # predecessor's mon_commit(v) on another dispatch worker is
+        # nacked as non-contiguous, and a majority of such races makes
+        # the leader spuriously abdicate (round-5 advisor medium #1)
         m = mon.msgr
-        m.register("mon_propose", self._h_propose)
-        m.register("mon_victory", self._h_victory)
-        m.register("mon_lease", self._h_lease)
-        m.register("mon_fetch", self._h_fetch)
-        m.register("mon_accept", self._h_accept)
-        m.register("mon_commit", self._h_commit)
+        m.register("mon_probe", self._h_probe, ordered=True)
+        m.register("mon_propose", self._h_propose, ordered=True)
+        m.register("mon_victory", self._h_victory, ordered=True)
+        m.register("mon_lease", self._h_lease, ordered=True)
+        m.register("mon_fetch", self._h_fetch, ordered=True)
+        m.register("mon_accept", self._h_accept, ordered=True)
+        m.register("mon_commit", self._h_commit, ordered=True)
 
         # restore the promise + staged entry a crash may have left
         # (Paxos.cc reads accepted_pn / uncommitted from the store).
@@ -197,8 +205,68 @@ class Quorum:
             self._start_election()
         elif outranked and due:
             self._start_election()
-        elif state in (PROBING, ELECTING) and due:
+        elif state == PROBING and due:
+            # discover an existing quorum before forcing a round: a
+            # RESTARTED member's immediate candidacy used to depose a
+            # healthy leader (its higher-epoch propose invalidates
+            # leadership on every peer) and seesaw elections for
+            # seconds — the thrash-test quorum outages.  The
+            # reference's probing phase (Monitor.cc handle_probe)
+            # joins an established quorum without an election.
+            if not self._probe():
+                self._start_election()
+        elif state == ELECTING and due:
             self._start_election()
+
+    # -- probe (rejoin without deposing) ----------------------------------
+    def _h_probe(self, _msg: Dict) -> Dict:
+        """Report current leadership (None unless the lease is live)
+        so a (re)starting monitor can rejoin as a peon."""
+        with self._lock:
+            leader = self.leader_rank
+            if self.state not in (LEADER, PEON) or \
+                    time.monotonic() > self.lease_expiry:
+                leader = None
+            return {"leader": leader, "epoch": self.election_epoch,
+                    "last_committed": self.mon.last_committed()}
+
+    def _probe(self) -> bool:
+        """Ask peers for the standing quorum; adopt it when found.
+        Returns False when no live leader is known anywhere — the
+        caller elects.  A provisional lease window is granted; if the
+        reported leader is actually gone, its non-renewal leads to a
+        normal election one window later."""
+        for r, addr in self._others():
+            try:
+                rep = self.mon.msgr.call(
+                    addr, {"type": "mon_probe"},
+                    timeout=min(self.call_timeout, 0.5))
+            except (OSError, TimeoutError):
+                continue
+            leader = rep.get("leader")
+            e = int(rep.get("epoch", 0))
+            with self._lock:
+                if leader is None or e < self.election_epoch:
+                    continue
+                if int(leader) == self.rank:
+                    # a peer still believes the PRE-restart us leads;
+                    # leadership without a fresh collect majority is
+                    # unsafe — run the election instead
+                    continue
+                if self.state != PROBING:
+                    return True  # something else settled us meanwhile
+                if e > self.election_epoch:
+                    self.promised_rank = None  # new epoch, new promise
+                self.election_epoch = e
+                self.leader_rank = int(leader)
+                self.state = PEON
+                self.lease_expiry = time.monotonic() + self.lease * 3
+                self._persist_locked()
+            self.mon.log.dout(1, f"mon.{self.rank}: probe found "
+                                 f"leader mon.{leader} at epoch {e}; "
+                                 f"joining as peon")
+            return True
+        return False
 
     # -- election ---------------------------------------------------------
     def _start_election(self) -> None:

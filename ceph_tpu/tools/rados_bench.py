@@ -25,6 +25,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..analysis.lockdep import make_lock
+
 
 class BenchResult:
     def __init__(self, op: str, object_size: int):
@@ -33,7 +35,7 @@ class BenchResult:
         self.latencies: List[float] = []
         self.errors = 0
         self.wall = 0.0
-        self._lock = threading.Lock()
+        self._lock = make_lock("bench::result")
 
     def add(self, dt: float) -> None:
         with self._lock:
@@ -88,7 +90,7 @@ class ObjBencher:
         res = BenchResult(op, self.object_size)
         stop = time.monotonic() + seconds
         counter = [0]
-        clock = threading.Lock()
+        clock = make_lock("bench::counter")
 
         def worker(wid: int):
             while time.monotonic() < stop:

@@ -13,6 +13,18 @@ created) and inject the virtual-device XLA flag before any client exists.
 import os
 import pathlib
 import sys
+import threading
+import time
+import warnings
+
+import pytest
+
+# lockdep on for the WHOLE suite (overridable with CEPH_TPU_LOCKDEP=0):
+# every test inherits the lock-order checker, so a future PR that
+# introduces an inversion fails its own tests with both witness
+# stacks.  Must precede any ceph_tpu import — make_lock() decides
+# wrapper-vs-raw at construction time.
+os.environ.setdefault("CEPH_TPU_LOCKDEP", "1")
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -41,3 +53,66 @@ except Exception:
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 GOLDEN_DIR = pathlib.Path(__file__).resolve().parent / "golden"
+
+from ceph_tpu.analysis import lockdep, watchdog  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _stall_watchdog():
+    """Session-wide stall watchdog: a test that wedges a lock or a
+    messenger handler gets an all-thread stack dump on stderr while
+    it hangs, instead of an opaque suite timeout."""
+    yield watchdog.start_global(threshold=30.0)
+
+
+@pytest.fixture(autouse=True)
+def _concurrency_gate(request):
+    """Per-test concurrency gates.
+
+    1. Lockdep: any lock-order violation recorded during the test
+       fails THAT test (witness stacks were already printed).
+    2. Thread leak: threads a test spawned must be gone shortly after
+       it finishes.  Leaked non-daemon threads fail the test; leaked
+       daemon threads (a cluster not fully shut down — the exact
+       cross-test interference that made the quorum rejoin test
+       flaky) get a grace period to die, then a warning.  Either way
+       the NEXT test starts from a quiesced process.
+    """
+    before = set(threading.enumerate())
+    base = len(lockdep.violations())
+    yield
+    vs = lockdep.violations()[base:]
+    if vs:
+        lockdep.clear_violations()  # don't re-fail every later test
+        detail = "\n".join(
+            f"- {v['message']} [{v['thread']}]\n"
+            f"  existing order recorded at:\n{v['existing_stack']}"
+            f"  conflicting order taken at:\n{v['current_stack']}"
+            for v in vs)
+        pytest.fail(f"lockdep: {len(vs)} lock-order violation(s) "
+                    f"during this test:\n{detail}")
+
+    def leaked():
+        return [t for t in threading.enumerate()
+                if t not in before and t.is_alive()]
+
+    # daemon-only stragglers get a short grace (they die with their
+    # sockets); anything non-daemon gets longer before failing
+    deadline = time.monotonic() + 1.5
+    hard_deadline = time.monotonic() + 5.0
+    left = leaked()
+    while left and time.monotonic() < deadline:
+        time.sleep(0.05)
+        left = leaked()
+    while left and any(not t.daemon for t in left) and \
+            time.monotonic() < hard_deadline:
+        time.sleep(0.05)
+        left = leaked()
+    bad = [t for t in left if not t.daemon]
+    assert not bad, (f"test leaked non-daemon thread(s): "
+                     f"{[t.name for t in bad]}")
+    if left:
+        warnings.warn(
+            f"{request.node.nodeid} leaked daemon thread(s): "
+            f"{sorted(t.name for t in left)[:10]}"
+            f"{'...' if len(left) > 10 else ''}")

@@ -1,0 +1,173 @@
+"""Concurrency lint (tools/lint_concurrency.py): rule unit tests on
+synthetic modules, plus the enforcement test that keeps ``ceph_tpu/``
+clean — a new raw lock, a blocking call under a lock, or a swallowing
+run-loop except fails CI here unless explicitly allowlisted with a
+``# conc-ok: <reason>`` justification."""
+
+import pathlib
+import textwrap
+
+from tools.lint_concurrency import lint_file, lint_paths
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _lint(tmp_path, source):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(source))
+    return lint_file(f)
+
+
+def codes(violations):
+    return [v.code for v in violations]
+
+
+def test_repo_is_clean():
+    violations = lint_paths([REPO / "ceph_tpu"])
+    assert not violations, "\n".join(str(v) for v in violations)
+
+
+def test_raw_lock_construction_flagged(tmp_path):
+    vs = _lint(tmp_path, """
+        import threading
+        a = threading.Lock()
+        b = threading.RLock()
+    """)
+    assert codes(vs) == ["CONC001", "CONC001"]
+
+
+def test_registry_lock_not_flagged(tmp_path):
+    vs = _lint(tmp_path, """
+        from ceph_tpu.analysis.lockdep import make_lock
+        a = make_lock("x::y")
+    """)
+    assert vs == []
+
+
+def test_blocking_call_under_lock_flagged(tmp_path):
+    vs = _lint(tmp_path, """
+        import os, time
+
+        class S:
+            def write(self, f):
+                with self._lock:
+                    os.fsync(f.fileno())
+
+            def wait_holding(self):
+                with self._pg_lock(1, 2):
+                    time.sleep(1)
+
+            def rx(self, sock):
+                with self.buf_lock:
+                    sock.recv(4)
+
+            def sub(self):
+                with self._lock:
+                    self.sched.submit("client", lambda: 1)
+    """)
+    assert codes(vs) == ["CONC002"] * 4
+
+
+def test_blocking_call_outside_lock_ok(tmp_path):
+    vs = _lint(tmp_path, """
+        import os, time
+
+        class S:
+            def write(self, f):
+                with self._lock:
+                    n = 1
+                os.fsync(f.fileno())
+                time.sleep(0.1)
+
+            def pool(self):
+                # executor submit does not block; only sched.submit
+                with self._lock:
+                    self._pool.submit(print)
+    """)
+    assert vs == []
+
+
+def test_nested_def_under_lock_not_flagged(tmp_path):
+    """A function DEFINED under a lock runs later, lock-free."""
+    vs = _lint(tmp_path, """
+        import time
+
+        def outer(self):
+            with self._lock:
+                def cb():
+                    time.sleep(1)
+                return cb
+    """)
+    assert vs == []
+
+
+def test_swallowing_runloop_except_flagged(tmp_path):
+    vs = _lint(tmp_path, """
+        def _reader(self):
+            while self._running:
+                try:
+                    step()
+                except Exception:
+                    pass
+
+        def _serve(self):
+            while True:
+                try:
+                    step()
+                except:
+                    log(1)
+    """)
+    assert codes(vs) == ["CONC003", "CONC003"]
+
+
+def test_logging_or_narrow_runloop_except_ok(tmp_path):
+    vs = _lint(tmp_path, """
+        def _loop(self):
+            while self._running:
+                try:
+                    step()
+                except Exception as e:
+                    self.log.derr(repr(e))
+                try:
+                    step()
+                except OSError:
+                    break
+
+        def not_a_loop(self):
+            try:
+                step()
+            except Exception:
+                pass
+    """)
+    assert vs == []
+
+
+def test_conc_ok_suppression(tmp_path):
+    vs = _lint(tmp_path, """
+        import os, threading
+        a = threading.Lock()  # conc-ok: test fixture, not a daemon lock
+
+        def write(self, f):
+            with self._lock:
+                os.fsync(f.fileno())  # conc-ok: the fsync is the ack point
+    """)
+    assert vs == []
+
+
+def test_cli_exit_status(tmp_path):
+    import subprocess
+    import sys
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import threading\nx = threading.Lock()\n")
+    p = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_concurrency.py"),
+         str(bad)], capture_output=True, text=True)
+    assert p.returncode == 1
+    assert "CONC001" in p.stdout
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    p = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_concurrency.py"),
+         str(good)], capture_output=True, text=True)
+    assert p.returncode == 0

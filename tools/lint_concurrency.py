@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""Concurrency lint — static companion to ceph_tpu/analysis.
+
+AST-level checks for the thread-bug classes this framework has
+actually shipped (ADVICE round 5), enforced by tests/test_lint.py:
+
+CONC001  raw ``threading.Lock()`` / ``threading.RLock()`` construction
+         outside the lock registry.  Unregistered locks are invisible
+         to lockdep's order graph and the stall watchdog; build them
+         with ``make_lock(name)`` / ``make_rlock(name)``
+         (ceph_tpu/analysis/lockdep.py, re-exported by
+         common/context.py).
+
+CONC002  a known-blocking call (``fsync``, ``*.recv``, ``*.sleep`` /
+         ``time.sleep``, ``sched.submit``) lexically inside a ``with
+         <lock>`` block.  Blocking while holding a lock stalls every
+         other thread that needs it — the op_queue shutdown stall and
+         the "fsync per write serializes the daemon" class.
+
+CONC003  an except clause in a thread run-loop (a function containing
+         a ``while`` loop) that can swallow the loop's death: bare
+         ``except:`` / ``except BaseException`` anywhere in the loop,
+         or ``except Exception`` whose body is only pass/continue.
+         The messenger reader died silently from exactly this shape —
+         an exception class its narrow except missed, no log, a stale
+         connection leaked (messenger.py reader, ADVICE low #2).
+
+Suppression: append ``# conc-ok: <reason>`` to the offending line (or
+the ``with``/``except``/``def`` line introducing it).  The reason is
+mandatory — it is the allowlist entry.
+
+Usage:
+    python tools/lint_concurrency.py [paths...]   # default: ceph_tpu/
+Exit status 1 when violations are found.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+from dataclasses import dataclass
+from typing import Iterable, List, Optional
+
+SUPPRESS_MARK = "conc-ok:"
+
+# files allowed to touch raw threading primitives: the registry itself
+ALLOW_RAW_FILES = ("analysis/lockdep.py", "analysis/watchdog.py")
+
+# names whose .attr call blocks by design
+BLOCKING_ATTRS = {"fsync", "recv", "sleep"}
+# lock-ish context-manager expressions: with self._lock, with
+# self._pg_lock(...), with clock, with sess.buf_lock, with self._cv ...
+LOCKISH_MARKERS = ("lock", "_cv", "_cond", "_serial", "mutex")
+
+
+@dataclass
+class Violation:
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _suppressed(src_lines: List[str], *linenos: int) -> bool:
+    for ln in linenos:
+        if 1 <= ln <= len(src_lines) and \
+                SUPPRESS_MARK in src_lines[ln - 1]:
+            return True
+    return False
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    """Heuristic: does this with-item expression denote a mutex?"""
+    try:
+        text = ast.unparse(expr)
+    except Exception:
+        return False
+    tail = text.split("(", 1)[0].rsplit(".", 1)[-1].lower()
+    return any(m in tail for m in LOCKISH_MARKERS)
+
+
+def _is_raw_lock_ctor(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute)
+            and f.attr in ("Lock", "RLock")
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "threading")
+
+
+def _is_blocking_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in BLOCKING_ATTRS:
+            return True
+        if f.attr == "submit":
+            # scheduler submission blocks until the op is served;
+            # executor .submit() does not — match the sched spelling
+            try:
+                owner = ast.unparse(f.value)
+            except Exception:
+                return False
+            return owner.rsplit(".", 1)[-1] == "sched"
+    elif isinstance(f, ast.Name) and f.id in BLOCKING_ATTRS:
+        return True
+    return False
+
+
+def _broad_except(handler: ast.ExceptHandler) -> Optional[str]:
+    """None, or why this handler can swallow the loop's death."""
+    def names(t) -> List[str]:
+        if t is None:
+            return ["<bare>"]
+        if isinstance(t, ast.Tuple):
+            return [n for e in t.elts for n in names(e)]
+        try:
+            return [ast.unparse(t).rsplit(".", 1)[-1]]
+        except Exception:
+            return []
+
+    caught = names(handler.type)
+    if "<bare>" in caught or "BaseException" in caught:
+        return ("catches everything (KeyboardInterrupt/SystemExit "
+                "included)")
+    if "Exception" in caught:
+        silent = all(isinstance(s, (ast.Pass, ast.Continue))
+                     for s in handler.body)
+        if silent:
+            return "catches Exception and discards it silently"
+    return None
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, path: str, rel: str, src: str):
+        self.path = path
+        self.rel = rel
+        self.lines = src.splitlines()
+        self.out: List[Violation] = []
+        self._with_lock_stack: List[int] = []  # lineno of lock withs
+
+    def _emit(self, code: str, node: ast.AST, message: str,
+              *extra_lines: int) -> None:
+        if _suppressed(self.lines, node.lineno, *extra_lines):
+            return
+        self.out.append(Violation(self.rel, node.lineno, code,
+                                  message))
+
+    # -- CONC001 ------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_raw_lock_ctor(node) and not any(
+                self.rel.endswith(f) for f in ALLOW_RAW_FILES):
+            self._emit(
+                "CONC001", node,
+                "raw threading lock bypasses the lockdep registry; "
+                "use make_lock(name)/make_rlock(name)")
+        if self._with_lock_stack and _is_blocking_call(node):
+            self._emit(
+                "CONC002", node,
+                f"blocking call {ast.unparse(node.func)!r} while a "
+                f"lock is held (with-block at line "
+                f"{self._with_lock_stack[-1]})",
+                self._with_lock_stack[-1])
+        self.generic_visit(node)
+
+    # -- CONC002 scope tracking --------------------------------------
+    def visit_With(self, node: ast.With) -> None:
+        lockish = any(_is_lockish(item.context_expr)
+                      for item in node.items)
+        for item in node.items:
+            self.visit(item)
+        if lockish:
+            self._with_lock_stack.append(node.lineno)
+        for stmt in node.body:
+            self.visit(stmt)
+        if lockish:
+            self._with_lock_stack.pop()
+
+    def _visit_function(self, node) -> None:
+        # a nested def is a fresh frame: a lock held by the enclosing
+        # function is NOT held when the inner one eventually runs
+        saved = self._with_lock_stack
+        self._with_lock_stack = []
+        self.generic_visit(node)
+        self._with_lock_stack = saved
+        # -- CONC003 --------------------------------------------------
+        for loop in ast.walk(node):
+            if not isinstance(loop, ast.While):
+                continue
+            for sub in ast.walk(loop):
+                if not isinstance(sub, ast.Try):
+                    continue
+                for handler in sub.handlers:
+                    why = _broad_except(handler)
+                    if why:
+                        self._emit(
+                            "CONC003", handler,
+                            f"run-loop except in {node.name!r} {why}; "
+                            f"a dying loop thread must log or "
+                            f"re-raise, never vanish", node.lineno)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_function(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._visit_function(node)
+
+
+def lint_file(path: pathlib.Path,
+              root: Optional[pathlib.Path] = None) -> List[Violation]:
+    rel = str(path if root is None else path.relative_to(root))
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [Violation(rel, e.lineno or 0, "CONC000",
+                          f"unparseable: {e.msg}")]
+    linter = _FileLinter(str(path), rel, src)
+    linter.visit(tree)
+    return sorted(linter.out, key=lambda v: v.line)
+
+
+def lint_paths(paths: Iterable[pathlib.Path]) -> List[Violation]:
+    out: List[Violation] = []
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_dir():
+            root = p.parent
+            for f in sorted(p.rglob("*.py")):
+                out.extend(lint_file(f, root=root))
+        else:
+            out.extend(lint_file(p))
+    return out
+
+
+def main(argv: List[str]) -> int:
+    targets = [pathlib.Path(a) for a in argv] or \
+        [pathlib.Path(__file__).resolve().parents[1] / "ceph_tpu"]
+    violations = lint_paths(targets)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} concurrency lint violation(s)")
+        return 1
+    print("concurrency lint clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
