@@ -144,9 +144,24 @@ def wire_defaults(sock: AdminSocket, config=None, perf=None,
         "locks held and handlers stalled beyond a threshold, with "
         "per-thread stacks")
     if perf is not None:
-        sock.register("perf dump",
-                      lambda a: perf.dump(a.get("logger")),
-                      "dump perf counters")
+        def _perf_dump(a):
+            # the daemon's own collection, merged over the
+            # PROCESS-GLOBAL library counters (ec.engine,
+            # crush.mapper, crush.scalar — kernels shared by every
+            # in-process daemon, perf_counters.collection()); the
+            # daemon's loggers win on a name collision
+            from .perf_counters import collection
+
+            merged = dict(collection().dump())
+            merged.update(perf.dump())
+            lg = a.get("logger")
+            if lg:
+                return {lg: merged.get(lg, {})}
+            return merged
+
+        sock.register("perf dump", _perf_dump,
+                      "dump perf counters (daemon + shared library "
+                      "kernels; ?logger= filters)")
     if config is not None:
         sock.register("config show", lambda _a: config.show(),
                       "dump config options with sources")

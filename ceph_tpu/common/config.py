@@ -91,6 +91,17 @@ OPTIONS: Dict[str, Option] = _opts(
            "seconds a lock may stay held or a handler may run before "
            "the stall watchdog dumps all-thread stacks "
            "(analysis/watchdog.py; also the dump_blocked default)"),
+    Option("trace_sample_rate", float, 1.0,
+           "probability a new trace ROOT is sampled (children inherit "
+           "the root's decision, across daemons); unsampled spans "
+           "propagate context but are never recorded"),
+    Option("trace_ring_size", int, 512,
+           "finished spans retained per tracer (the dump_tracing ring "
+           "buffer, newest-wins)"),
+    Option("admin_socket", bool, True,
+           "daemons bind their unix admin socket on start (perf dump, "
+           "dump_tracing, dump_ops_in_flight, dump_blocked ... — the "
+           "surface the telemetry tool polls)"),
 )
 
 

@@ -20,6 +20,7 @@ from .admin_socket import AdminSocket, wire_defaults
 from .config import Config
 from .log import LogCore, SubsysLogger
 from .perf_counters import PerfCountersCollection
+from .tracing import Tracer
 
 
 class Context:
@@ -36,6 +37,11 @@ class Context:
             lockdep.enable(True)
         self.log = LogCore(max_recent=self.conf["log_max_recent"])
         self.perf = PerfCountersCollection()
+        # the daemon's tracing plane (common/tracing.py): services and
+        # their messengers share this tracer, so one op's spans nest
+        self.tracer = Tracer(name,
+                             ring_size=self.conf["trace_ring_size"],
+                             sample_rate=self.conf["trace_sample_rate"])
         self._admin: Optional[AdminSocket] = None
         self._admin_dir = admin_dir
         # (option, callback) pairs to detach on shutdown — contexts may

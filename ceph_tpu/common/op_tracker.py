@@ -30,6 +30,12 @@ class TrackedOp:
         self.events.append((time.time(), event))
 
     def finish(self) -> None:
+        """Idempotent: a second finish (an explicit finish inside a
+        ``with`` block, or a double completion path) must not append a
+        second "done" event, re-insert the op into history/slow, or
+        double-count ``_served``."""
+        if self.done is not None:
+            return
         self.done = time.time()
         self.events.append((self.done, "done"))
         self._tracker._finish(self)

@@ -9,6 +9,7 @@ every collection (perf_counters.h:63-141 / PerfCountersCollection).
 
 from __future__ import annotations
 
+import math
 from typing import Dict, List, Optional, Tuple
 
 from ..analysis.lockdep import make_lock
@@ -27,7 +28,19 @@ class PerfCounters:
         self._values: Dict[str, float] = {}
         self._avgs: Dict[str, Tuple[int, float]] = {}
         self._hists: Dict[str, List[int]] = {}
+        self._hist_mins: Dict[str, float] = {}
         self._lock = make_lock("perf::counters")
+
+    def _require(self, key: str, *allowed: str) -> str:
+        """A typo'd key on a hot path must raise a clear error, not a
+        bare KeyError deep inside an update."""
+        t = self._types.get(key)
+        assert t is not None, \
+            f"perf counter {self.name!r} has no key {key!r}"
+        assert t in allowed, \
+            (f"perf counter {self.name}/{key} is {t}, not one of "
+             f"{allowed}")
+        return t
 
     # -- declaration (PerfCountersBuilder) ----------------------------
     def add_u64_counter(self, key: str, desc: str = "") -> None:
@@ -47,39 +60,53 @@ class PerfCounters:
         self._avgs[key] = (0, 0.0)
 
     def add_histogram(self, key: str, buckets: int = 32,
-                      desc: str = "") -> None:
+                      desc: str = "", min_value: float = 1e-6) -> None:
+        """Log2 buckets anchored at ``min_value``: bucket 0 holds
+        values <= min_value, bucket i holds (min*2^(i-1), min*2^i].
+        The default floor of 1 µs makes sub-second LATENCIES resolve
+        (the old ``int(value).bit_length()`` scheme collapsed every
+        sub-second sample into bucket 0); byte-sized histograms pass
+        ``min_value=1``."""
         self._types[key] = HISTOGRAM
         self._hists[key] = [0] * buckets
+        self._hist_mins[key] = float(min_value)
 
     # -- updates ------------------------------------------------------
     def inc(self, key: str, amount: float = 1) -> None:
+        self._require(key, U64, GAUGE, TIME)
         with self._lock:
             self._values[key] += amount
 
     def dec(self, key: str, amount: float = 1) -> None:
-        assert self._types[key] == GAUGE
+        self._require(key, GAUGE)
         with self._lock:
             self._values[key] -= amount
 
     def set(self, key: str, value: float) -> None:
+        self._require(key, GAUGE, U64)
         with self._lock:
             self._values[key] = value
 
     def tinc(self, key: str, seconds: float) -> None:
-        assert self._types[key] == TIME
+        self._require(key, TIME)
         with self._lock:
             self._values[key] += seconds
 
     def avg_add(self, key: str, value: float) -> None:
-        assert self._types[key] == AVG
+        self._require(key, AVG)
         with self._lock:
             n, s = self._avgs[key]
             self._avgs[key] = (n + 1, s + value)
 
     def hist_add(self, key: str, value: float) -> None:
-        assert self._types[key] == HISTOGRAM
+        self._require(key, HISTOGRAM)
         hist = self._hists[key]
-        bucket = min(len(hist) - 1, max(0, int(value).bit_length()))
+        lo = self._hist_mins[key]
+        if value <= lo:
+            bucket = 0
+        else:
+            bucket = min(len(hist) - 1,
+                         1 + int(math.floor(math.log2(value / lo))))
         with self._lock:
             hist[bucket] += 1
 
@@ -93,7 +120,8 @@ class PerfCounters:
                     out[key] = {"avgcount": n, "sum": s,
                                 "avg": (s / n) if n else 0.0}
                 elif t == HISTOGRAM:
-                    out[key] = {"buckets": list(self._hists[key])}
+                    out[key] = {"buckets": list(self._hists[key]),
+                                "min": self._hist_mins[key]}
                 else:
                     out[key] = self._values[key]
             return out

@@ -53,10 +53,22 @@ from jax import lax  # noqa: E402
 
 from . import constants as C  # noqa: E402
 from . import hash as H  # noqa: E402
+from ..common.perf_counters import collection  # noqa: E402
 from .ln import (LL_NP, RH_LH_NP, ln16_table, recip64,  # noqa: E402
                  straw2_draw, straw2_key)
 from .map import ChooseArgMap, CrushMap  # noqa: E402
 from .map_arrays import MapArrays, MapStatic, encode_map  # noqa: E402
+
+# process-global batched-mapper metrics (served through every daemon's
+# `perf dump`, which merges the global collection): launch count/size,
+# steady-state latency, and first-call JIT compile count/time kept
+# SEPARATE so compile cost never pollutes the steady-state histogram
+_pc = collection().create("crush.mapper")
+for _k in ("map_calls", "xs_mapped", "jit_compiles"):
+    _pc.add_u64_counter(_k)
+_pc.add_time("map_time")
+_pc.add_time("jit_compile_time")
+_pc.add_histogram("map_lat")
 
 I32 = jnp.int32
 U32 = jnp.uint32
@@ -809,6 +821,7 @@ class BatchedMapper:
         self.cmap = cmap
         self.choose_args = choose_args
         self._cache = {}
+        self._compiled_sigs: set = set()  # (rule, result_max, N)
         self._encoded = encode_map(cmap, choose_args)
         self._arrays = jax.tree_util.tree_map(
             jnp.asarray, self._encoded[1])
@@ -828,7 +841,22 @@ class BatchedMapper:
 
     def map_batch(self, ruleno: int, xs, result_max: int, weight):
         """Map a batch: xs uint32[N], weight 16.16 uint32[max_devices]."""
+        import time
+
         fn = self.rule_fn(ruleno, result_max)
         xs = jnp.asarray(np.asarray(xs, np.uint32))
         weight = jnp.asarray(np.asarray(weight, np.uint32))
-        return fn(self._arrays, weight, xs)
+        t0 = time.monotonic()
+        out = fn(self._arrays, weight, xs)
+        dt = time.monotonic() - t0
+        _pc.inc("map_calls")
+        _pc.inc("xs_mapped", int(xs.shape[0]))
+        sig = (ruleno, result_max, tuple(xs.shape))
+        if sig not in self._compiled_sigs:
+            self._compiled_sigs.add(sig)
+            _pc.inc("jit_compiles")
+            _pc.tinc("jit_compile_time", dt)
+        else:
+            _pc.tinc("map_time", dt)
+            _pc.hist_add("map_lat", dt)
+        return out

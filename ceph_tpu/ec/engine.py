@@ -27,6 +27,7 @@ one matmul recovers all data rows; missing parity is re-encoded.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Dict, Sequence, Tuple
 
 import numpy as np
@@ -34,9 +35,45 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..common.perf_counters import collection
 from .gfw import gf2_mat_inv
 
 _BITS8 = np.arange(8, dtype=np.uint8)
+
+# -- instrumentation (process-global: the MXU kernels are shared by
+# every in-process daemon; served via each daemon's `perf dump`, which
+# merges the global collection).  First-call JIT compile cost books
+# under jit_compiles/jit_compile_time — keyed by kernel signature, the
+# same shape key XLA's own jit cache uses — so steady-state latency
+# histograms are not polluted by tracing+compilation.
+_pc = collection().create("ec.engine")
+for _k in ("encode_ops", "decode_ops", "encode_bytes",
+           "decode_bytes", "jit_compiles"):
+    _pc.add_u64_counter(_k)
+for _k in ("encode_time", "decode_time", "jit_compile_time"):
+    _pc.add_time(_k)
+_pc.add_histogram("encode_lat")
+_pc.add_histogram("decode_lat")
+# signatures already traced+compiled; set membership races only
+# double-count a compile, they never corrupt (CPython set ops are
+# atomic)
+_seen_sigs: set = set()
+
+
+def _account(kind: str, sig: tuple, dt: float, nbytes: int,
+             jitted: bool = True) -> None:
+    """Shared by every EC execution engine (the jitted bit-plane path
+    here and native_gf's table engine, which passes jitted=False —
+    it has no compile step to separate out)."""
+    _pc.inc(f"{kind}_ops")
+    _pc.inc(f"{kind}_bytes", nbytes)
+    if jitted and sig not in _seen_sigs:
+        _seen_sigs.add(sig)
+        _pc.inc("jit_compiles")
+        _pc.tinc("jit_compile_time", dt)
+    else:
+        _pc.tinc(f"{kind}_time", dt)
+        _pc.hist_add(f"{kind}_lat", dt)
 
 
 @jax.jit
@@ -165,12 +202,21 @@ class BitCode:
         data = jnp.asarray(data)
         assert data.shape[0] == self.k
         self.layout.check(data.shape[1])
+        t0 = time.monotonic()
         pk = self._fused_w8()
         if pk is not None:
-            return pk.fused_gf2_matmul_w8(self._enc_dev, data)
-        rows = self.layout.to_rows(data)
-        out = _mod2_matmul(self._enc_dev, rows)
-        return self.layout.from_rows(out, self.m, data.shape[1])
+            out = pk.fused_gf2_matmul_w8(self._enc_dev, data)
+        else:
+            rows = self.layout.to_rows(data)
+            out = self.layout.from_rows(
+                _mod2_matmul(self._enc_dev, rows), self.m,
+                data.shape[1])
+        _account("encode",
+                 ("enc", self.coding_bm.shape, tuple(data.shape),
+                  self.layout.w, self.layout.packetsize,
+                  pk is not None),
+                 time.monotonic() - t0, int(data.size))
+        return out
 
     def all_chunks(self, data):
         data = jnp.asarray(data)
@@ -203,12 +249,20 @@ class BitCode:
         stack = jnp.stack([jnp.asarray(chunks[i]) for i in present])
         L = stack.shape[1]
         self.layout.check(L)
+        t0 = time.monotonic()
         pk = self._fused_w8()
         if pk is not None:
-            return pk.fused_gf2_matmul_w8(inv, stack)
-        rows = self.layout.to_rows(stack)
-        out = _mod2_matmul(inv, rows)
-        return self.layout.from_rows(out, self.k, L)
+            out = pk.fused_gf2_matmul_w8(inv, stack)
+        else:
+            rows = self.layout.to_rows(stack)
+            out = self.layout.from_rows(_mod2_matmul(inv, rows),
+                                        self.k, L)
+        _account("decode",
+                 ("dec", inv.shape, tuple(stack.shape),
+                  self.layout.w, self.layout.packetsize,
+                  pk is not None),
+                 time.monotonic() - t0, int(stack.size))
+        return out
 
     def decode(self, want: Sequence[int], chunks: Dict[int, "jnp.ndarray"]):
         """Reconstruct the wanted chunk ids (data and/or parity).

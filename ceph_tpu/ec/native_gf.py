@@ -106,9 +106,17 @@ class NativeMatrixCode:
         self._dec_cache: Dict[tuple, np.ndarray] = {}
 
     def encode(self, data) -> np.ndarray:
+        import time
+
+        from .engine import _account
+
         data = np.asarray(data, np.uint8)
         assert data.shape[0] == self.k
-        return gf8_matmul(self.G[self.k:], data)
+        t0 = time.monotonic()
+        out = gf8_matmul(self.G[self.k:], data)
+        _account("encode", (), time.monotonic() - t0,
+                 int(data.size), jitted=False)
+        return out
 
     def all_chunks(self, data) -> np.ndarray:
         data = np.asarray(data, np.uint8)
@@ -126,9 +134,17 @@ class NativeMatrixCode:
             if len(self._dec_cache) >= 512:  # IsaTableCache-style bound
                 self._dec_cache.pop(next(iter(self._dec_cache)))
             self._dec_cache[present] = dm
+        import time
+
+        from .engine import _account
+
         stack = np.stack([np.asarray(chunks[i], np.uint8)
                           for i in present])
-        return gf8_matmul(dm, stack)
+        t0 = time.monotonic()
+        out = gf8_matmul(dm, stack)
+        _account("decode", (), time.monotonic() - t0,
+                 int(stack.size), jitted=False)
+        return out
 
     def decode(self, want: Sequence[int],
                chunks: Dict[int, np.ndarray]) -> Dict[int, np.ndarray]:

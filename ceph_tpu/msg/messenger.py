@@ -59,6 +59,8 @@ from typing import Callable, Dict, Optional, Tuple
 from ..analysis import watchdog
 from ..analysis.lockdep import make_lock, make_rlock
 from ..common.log import getLogger
+from ..common.perf_counters import PerfCounters
+from ..common.tracing import Tracer
 
 Addr = Tuple[str, int]
 Handler = Callable[[Dict], Optional[Dict]]
@@ -126,7 +128,9 @@ def _restore_blobs(obj, blobs: list):
     return obj
 
 
-def _send_frame(sock: socket.socket, msg: Dict, keyring=None) -> None:
+def _send_frame(sock: socket.socket, msg: Dict, keyring=None) -> int:
+    """Returns the wire size (header + payload) for the byte
+    counters."""
     blobs: list = []
     jmsg = _lift_blobs(msg, blobs)
     if keyring is not None:
@@ -149,6 +153,7 @@ def _send_frame(sock: socket.socket, msg: Dict, keyring=None) -> None:
             lock = _send_locks[id(sock)] = make_lock("msgr::send")
     with lock:
         sock.sendall(struct.pack(">I", len(payload)) + payload)
+    return len(payload) + 4
 
 
 def _recv_exact(sock: socket.socket, n: int):
@@ -284,11 +289,28 @@ class _InSession:
 class Messenger:
     def __init__(self, name: str, host: str = "127.0.0.1",
                  port: int = 0, keyring=None, lossless: bool = False,
-                 throttles: Optional[Dict[str, object]] = None):
+                 throttles: Optional[Dict[str, object]] = None,
+                 tracer: Optional[Tracer] = None, perf=None):
         self.name = name
         self.log = getLogger("msgr")
         self.keyring = keyring  # cephx-style frame auth when set
         self.lossless = lossless
+        # the tracing plane: daemons pass their context's tracer so
+        # transport spans nest under service spans; a standalone
+        # messenger (CLI, tests) gets its own
+        self.tracer = tracer if tracer is not None else Tracer(
+            f"msgr.{name}")
+        # wire + dispatch metrics; registered into the daemon's
+        # collection when one is passed (so `perf dump` serves them),
+        # else standalone
+        self.pc = perf.create(f"msgr.{name}") if perf is not None \
+            else PerfCounters(f"msgr.{name}")
+        for key in ("bytes_in", "bytes_out", "frames_in",
+                    "frames_out"):
+            self.pc.add_u64_counter(key)
+        # receipt -> handler completion (queue wait + execution)
+        self.pc.add_histogram("dispatch_lat")
+        self.pc.add_time("dispatch_time")
         self.session_id = uuid.uuid4().hex[:16]
         self.throttles = throttles or {}
         self._handlers: Dict[str, Handler] = {}
@@ -385,6 +407,8 @@ class Messenger:
                 if got is None:
                     break
                 msg, blobs, nbytes = got
+                self.pc.inc("bytes_in", nbytes + 4)
+                self.pc.inc("frames_in")
                 try:
                     self._dispatch(conn, msg, blobs, nbytes)
                 except Exception as e:
@@ -459,10 +483,13 @@ class Messenger:
         """Sign-at-wire-time send: frames are stored/buffered unsigned
         (and may hold raw ``bytes`` values); the MAC is computed over
         the lifted control segment + data-segment digests."""
-        _send_frame(conn, msg, self.keyring)
+        n = _send_frame(conn, msg, self.keyring)
+        self.pc.inc("bytes_out", n)
+        self.pc.inc("frames_out")
 
     def _dispatch(self, conn: socket.socket, msg: Dict, blobs: list,
                   nbytes: int) -> None:
+        t_rx = time.monotonic()  # dispatch_lat anchor: frame receipt
         if self.keyring is not None and \
                 not self.keyring.verify(msg, blobs):
             return  # unauthenticated frame: drop silently (cephx deny)
@@ -523,7 +550,7 @@ class Messenger:
         # in the reference's sharded op queues.
         if ins is not None and type_ in self._ordered:
             with self._in_lock:
-                ins.fifo.append((conn, msg, seq, nbytes))
+                ins.fifo.append((conn, msg, seq, nbytes, t_rx))
                 drain = not ins.draining
                 if drain:
                     ins.draining = True
@@ -531,7 +558,7 @@ class Messenger:
                 self._pool_submit(self._drain_session, ins)
         else:
             self._pool_submit(self._handle, conn, msg, ins, seq,
-                              nbytes)
+                              nbytes, t_rx)
 
     def _drain_session(self, ins: _InSession) -> None:
         """Serial lane worker: run one session's queued frames in
@@ -543,9 +570,9 @@ class Messenger:
                 if not ins.fifo:
                     ins.draining = False
                     return
-                conn, msg, seq, nbytes = ins.fifo.popleft()
+                conn, msg, seq, nbytes, t_rx = ins.fifo.popleft()
             try:
-                self._handle(conn, msg, ins, seq, nbytes)
+                self._handle(conn, msg, ins, seq, nbytes, t_rx)
             except Exception as e:
                 # the lane must survive a poisoned op, or every later
                 # frame from this session queues forever
@@ -580,7 +607,8 @@ class Messenger:
             pass  # shutting down
 
     def _handle(self, conn: socket.socket, msg: Dict,
-                ins: Optional[_InSession], seq, nbytes: int) -> None:
+                ins: Optional[_InSession], seq, nbytes: int,
+                t_rx: Optional[float] = None) -> None:
         type_ = msg.get("type", "")
         throttle = self.throttles.get(type_)
         if throttle is not None:
@@ -595,13 +623,23 @@ class Messenger:
             if handler is None:
                 reply = {"error": f"no handler for {type_!r}"}
             else:
-                # watchdog-visible: a handler wedged on a lock or a
-                # peer RPC shows up in dump_blocked with its stack
-                with watchdog.section(f"{self.name}:{type_}"):
-                    try:
-                        reply = handler(msg)
-                    except Exception as e:
-                        reply = {"error": str(e)}
+                # child span of the sender's call/send span when the
+                # frame carries trace context (the server half of the
+                # rpc); the no-op span otherwise, so untraced traffic
+                # never fills the ring
+                with self.tracer.start_span(
+                        f"handle:{type_}",
+                        child_of=msg.get("trace"),
+                        require_parent=True,
+                        tags={"frm": msg.get("frm", "")}) as sp:
+                    # watchdog-visible: a handler wedged on a lock or a
+                    # peer RPC shows up in dump_blocked with its stack
+                    with watchdog.section(f"{self.name}:{type_}"):
+                        try:
+                            reply = handler(msg)
+                        except Exception as e:
+                            sp.set_tag("error", repr(e))
+                            reply = {"error": str(e)}
         finally:
             if throttle is not None:
                 throttle.put(nbytes)
@@ -626,6 +664,10 @@ class Messenger:
                                   "addr": list(self.addr)})
             except OSError:
                 pass
+        if t_rx is not None:
+            dt = time.monotonic() - t_rx
+            self.pc.hist_add("dispatch_lat", dt)
+            self.pc.tinc("dispatch_time", dt)
 
     def _reply(self, conn, msg: Dict, payload: Dict) -> None:
         if msg.get("tid") is not None:
@@ -806,29 +848,53 @@ class Messenger:
 
     def send(self, addr: Addr, msg: Dict) -> None:
         """Fire-and-forget.  Lossless: sequenced + replayed across
-        reconnects.  Lossy: one silent reconnect attempt."""
-        if self.lossless:
-            try:
-                # bounded: a fire-and-forget caller (heartbeat loop,
-                # map pusher) must not wedge behind a dead session's
-                # resync; the unacked buffer owns delivery anyway
-                self._send_sequenced(addr, msg, timeout=2.0)
-            except (OSError, TimeoutError):
-                pass  # unacked buffer + resync own the retry
-            return
-        for _ in range(2):
-            try:
-                self._send(self._connect(addr), msg)
+        reconnects.  Lossy: one silent reconnect attempt.  When an op
+        is being traced on this thread the frame carries the span
+        context (no-op span — and no wire field — otherwise)."""
+        with self.tracer.start_span(
+                f"send:{msg.get('type', '?')}", require_parent=True,
+                tags={"peer": f"{addr[0]}:{addr[1]}"}) as sp:
+            carrier = self.tracer.inject(sp)
+            if carrier is not None:
+                msg = dict(msg, trace=carrier)
+            if self.lossless:
+                try:
+                    # bounded: a fire-and-forget caller (heartbeat
+                    # loop, map pusher) must not wedge behind a dead
+                    # session's resync; the unacked buffer owns
+                    # delivery anyway
+                    self._send_sequenced(addr, msg, timeout=2.0)
+                except (OSError, TimeoutError):
+                    pass  # unacked buffer + resync own the retry
                 return
-            except OSError:
-                self._drop(addr)
+            for _ in range(2):
+                try:
+                    self._send(self._connect(addr), msg)
+                    return
+                except OSError:
+                    self._drop(addr)
 
     def call(self, addr: Addr, msg: Dict,
              timeout: float = 10.0) -> Dict:
         """Request/response correlated by tid.  On a lossless
         messenger the request is sequenced: if the connection drops
         after the peer processed it, the retransmission is deduped and
-        the cached reply resent — exactly-once execution."""
+        the cached reply resent — exactly-once execution.
+
+        Tracing: every call gets a span (a child of this thread's
+        active span when one exists, else a new root) and the frame
+        carries its context, so the peer's handler span joins the
+        same trace."""
+        with self.tracer.start_span(
+                f"call:{msg.get('type', '?')}",
+                tags={"peer": f"{addr[0]}:{addr[1]}"}) as sp:
+            carrier = self.tracer.inject(sp)
+            if carrier is not None:
+                msg = dict(msg, trace=carrier)
+            return self._call(addr, msg, timeout)
+
+    def _call(self, addr: Addr, msg: Dict,
+              timeout: float = 10.0) -> Dict:
         tid = uuid.uuid4().hex
         deadline = time.monotonic() + timeout
         seq = None

@@ -11,6 +11,7 @@ the map-epoch retry loop every RADOS op runs.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import threading
 import time
@@ -19,6 +20,9 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..analysis.lockdep import make_rlock
+from ..common.op_tracker import OpTracker
+from ..common.perf_counters import collection
+from ..common.tracing import Tracer
 from ..common.version import make_version
 from ..msg.messenger import Addr, Messenger
 from ..osdmap.osdmap import OSDMap, POOL_TYPE_ERASURE
@@ -45,11 +49,32 @@ from .map_follower import MapFollower
 
 class Client(MapFollower):
     def __init__(self, name: str, mon_addr: Addr,
-                 host: str = "127.0.0.1", keyring=None):
+                 host: str = "127.0.0.1", keyring=None, ctx=None):
         self.name = name
+        self.ctx = ctx  # optional Context: librados' own admin socket
+        # role — perf dump / dump_tracing / dump_ops_in_flight for the
+        # CLIENT side of an op, polled by the telemetry tool
         self._init_mons(mon_addr)  # one addr or the quorum list
+        if ctx is not None:
+            self.tracer = ctx.tracer
+            self.pc = ctx.perf.create(f"client.{name}")
+        else:
+            self.tracer = Tracer(f"client.{name}")
+            self.pc = collection().create(f"client.{name}")
+        for key in ("ops_put", "ops_get", "ops_write", "ops_delete",
+                    "op_errors"):
+            self.pc.add_u64_counter(key)
+        self.pc.add_histogram("op_lat")
+        self.pc.add_time("op_time")
+        self.optracker = OpTracker()
+        if ctx is not None and ctx.conf["admin_socket"]:
+            sock = ctx.start_admin_socket()
+            self.optracker.wire(sock)
+            self.tracer.wire(sock)
         self.msgr = Messenger(f"client.{name}", host, 0,
-                              keyring=keyring)
+                              keyring=keyring, tracer=self.tracer,
+                              perf=ctx.perf if ctx is not None
+                              else None)
         self.msgr.register("map_update", self._h_map_update)
         self.msgr.register("map_inc", self._h_map_inc)
         self.msgr.register("watch_notify", self._h_watch_notify)
@@ -67,6 +92,30 @@ class Client(MapFollower):
 
     def shutdown(self) -> None:
         self.msgr.shutdown()
+        if self.ctx is not None:
+            self.ctx.shutdown()
+
+    # -- op instrumentation (the librados op latency surface) ----------
+    @contextlib.contextmanager
+    def _op(self, kind: str, pool_id: int, oid: str):
+        """Root span + tracked op + latency counters around one client
+        op (retries included — the latency a caller actually sees)."""
+        t0 = time.monotonic()
+        with self.tracer.start_span(
+                f"client.{kind}",
+                tags={"pool": pool_id, "oid": oid}) as span:
+            with self.optracker.create(
+                    "client_op", f"{kind} {pool_id}/{oid}") as op:
+                try:
+                    yield span, op
+                except BaseException:
+                    self.pc.inc("op_errors")
+                    raise
+                finally:
+                    dt = time.monotonic() - t0
+                    self.pc.hist_add("op_lat", dt)
+                    self.pc.tinc("op_time", dt)
+        self.pc.inc(f"ops_{kind}")
 
     # -- map -----------------------------------------------------------
     def _h_map_update(self, msg: Dict) -> None:
@@ -103,43 +152,48 @@ class Client(MapFollower):
         client round trip; the primary stamps the version under the
         PG lock (eversion_t at the primary: immune to client clock
         skew) and fans replicas/shards out in parallel."""
-        for attempt in range(retries):
-            v = make_version(self.epoch)  # proposal; primary may bump
-            try:
-                # inside the retry loop: a freshly-created pool may be
-                # a map epoch away (a peon served the refresh before
-                # applying the commit) — KeyError retries like any
-                # stale-map condition
-                pool, ps, up = self._up(pool_id, oid)
-                code = self._code_for(pool)
-                if code is None:
-                    req = {"type": "rep_write", "pool": pool_id,
-                           "ps": ps, "oid": oid, "epoch": self.epoch,
-                           "data": bytes(data), "v": v}
-                else:
-                    req = {"type": "ec_write", "pool": pool_id,
-                           "ps": ps, "oid": oid, "offset": 0,
-                           "epoch": self.epoch,
-                           "data": bytes(data), "v": v, "full": True}
-                prim = self._first_reachable(up)
-                if prim is None:
-                    raise TimeoutError("no reachable primary")
-                got = self.msgr.call(self.osd_addrs[prim], req,
-                                     timeout=20)
-                if not got.get("ok") and \
-                        got.get("error") == "not primary" and \
-                        got.get("primary") in self.osd_addrs:
-                    got = self.msgr.call(
-                        self.osd_addrs[got["primary"]],
-                        dict(req), timeout=20)
-                if not got.get("ok"):
-                    raise OSError(f"put via osd.{prim}: {got}")
-                return
-            except (TimeoutError, OSError, KeyError):
-                if attempt + 1 == retries:
-                    raise
-                time.sleep(0.3)
-                self.refresh_map()
+        with self._op("put", pool_id, oid) as (_span, op):
+            for attempt in range(retries):
+                v = make_version(self.epoch)  # proposal; primary may
+                # bump
+                try:
+                    # inside the retry loop: a freshly-created pool
+                    # may be a map epoch away (a peon served the
+                    # refresh before applying the commit) — KeyError
+                    # retries like any stale-map condition
+                    pool, ps, up = self._up(pool_id, oid)
+                    code = self._code_for(pool)
+                    if code is None:
+                        req = {"type": "rep_write", "pool": pool_id,
+                               "ps": ps, "oid": oid,
+                               "epoch": self.epoch,
+                               "data": bytes(data), "v": v}
+                    else:
+                        req = {"type": "ec_write", "pool": pool_id,
+                               "ps": ps, "oid": oid, "offset": 0,
+                               "epoch": self.epoch,
+                               "data": bytes(data), "v": v,
+                               "full": True}
+                    prim = self._first_reachable(up)
+                    if prim is None:
+                        raise TimeoutError("no reachable primary")
+                    got = self.msgr.call(self.osd_addrs[prim], req,
+                                         timeout=20)
+                    if not got.get("ok") and \
+                            got.get("error") == "not primary" and \
+                            got.get("primary") in self.osd_addrs:
+                        got = self.msgr.call(
+                            self.osd_addrs[got["primary"]],
+                            dict(req), timeout=20)
+                    if not got.get("ok"):
+                        raise OSError(f"put via osd.{prim}: {got}")
+                    return
+                except (TimeoutError, OSError, KeyError):
+                    if attempt + 1 == retries:
+                        raise
+                    op.mark_event(f"retry {attempt + 1}")
+                    time.sleep(0.3)
+                    self.refresh_map()
 
     def get(self, pool_id: int, oid: str, retries: int = 3,
             notfound_retries: int = 2) -> bytes:
@@ -152,23 +206,26 @@ class Client(MapFollower):
         transient_left = retries - 1  # separate budgets: an ENOENT
         # retry must never convert into OSError('unreachable') when the
         # miss is definitive — callers branch on ObjectNotFound
-        while True:
-            try:
-                pool, ps, up = self._up(pool_id, oid)
-                code = self._code_for(pool)
-                if code is None:
-                    return self._read_replicated(pool_id, ps, oid, up)
-                return self._read_ec(pool_id, ps, oid, up, code)
-            except ObjectNotFound:
-                if nf_left <= 0:
-                    raise
-                nf_left -= 1
-            except (TimeoutError, OSError, KeyError):
-                if transient_left <= 0:
-                    raise
-                transient_left -= 1
-            time.sleep(0.3)
-            self.refresh_map()
+        with self._op("get", pool_id, oid) as (_span, op):
+            while True:
+                try:
+                    pool, ps, up = self._up(pool_id, oid)
+                    code = self._code_for(pool)
+                    if code is None:
+                        return self._read_replicated(pool_id, ps, oid,
+                                                     up)
+                    return self._read_ec(pool_id, ps, oid, up, code)
+                except ObjectNotFound:
+                    if nf_left <= 0:
+                        raise
+                    nf_left -= 1
+                except (TimeoutError, OSError, KeyError):
+                    if transient_left <= 0:
+                        raise
+                    transient_left -= 1
+                op.mark_event("retry")
+                time.sleep(0.3)
+                self.refresh_map()
 
     def _read_replicated(self, pool_id, ps, oid, up) -> bytes:
         """Version-aware: while divergent histories are still
@@ -219,51 +276,54 @@ class Client(MapFollower):
         it under the PG lock.  Replicated pools: client-side RMW over
         put (last-writer-wins at object granularity, like the
         reference's replicated offset write under a single client)."""
-        for attempt in range(retries):
-            try:
-                pool, ps, up = self._up(pool_id, oid)
-                code = self._code_for(pool)
-                if code is None:
-                    try:
-                        base = self.get(pool_id, oid,
-                                        notfound_retries=0)
-                    except ObjectNotFound:
-                        base = b""
-                    size = max(len(base), offset + len(data))
-                    buf = bytearray(size)
-                    buf[:len(base)] = base
-                    buf[offset:offset + len(data)] = data
-                    self.put(pool_id, oid, bytes(buf))
-                    return
-                # same liveness rule as the server's primary check:
-                # first UP member, else the op targets a dead daemon
-                # the real primary would skip
-                prim = self._first_reachable(up)
-                if prim is None:
-                    raise TimeoutError("no reachable primary")
-                v = make_version(self.epoch)
-                got = self.msgr.call(
-                    self.osd_addrs[prim],
-                    {"type": "ec_write", "pool": pool_id, "ps": ps,
-                     "oid": oid, "offset": offset,
-                     "data": bytes(data), "v": v}, timeout=15)
-                if got.get("ok"):
-                    return
-                if got.get("error") == "not primary" and \
-                        got.get("primary") in self.osd_addrs:
+        with self._op("write", pool_id, oid) as (_span, op):
+            for attempt in range(retries):
+                try:
+                    pool, ps, up = self._up(pool_id, oid)
+                    code = self._code_for(pool)
+                    if code is None:
+                        try:
+                            base = self.get(pool_id, oid,
+                                            notfound_retries=0)
+                        except ObjectNotFound:
+                            base = b""
+                        size = max(len(base), offset + len(data))
+                        buf = bytearray(size)
+                        buf[:len(base)] = base
+                        buf[offset:offset + len(data)] = data
+                        self.put(pool_id, oid, bytes(buf))
+                        return
+                    # same liveness rule as the server's primary
+                    # check: first UP member, else the op targets a
+                    # dead daemon the real primary would skip
+                    prim = self._first_reachable(up)
+                    if prim is None:
+                        raise TimeoutError("no reachable primary")
+                    v = make_version(self.epoch)
                     got = self.msgr.call(
-                        self.osd_addrs[got["primary"]],
+                        self.osd_addrs[prim],
                         {"type": "ec_write", "pool": pool_id,
                          "ps": ps, "oid": oid, "offset": offset,
                          "data": bytes(data), "v": v}, timeout=15)
                     if got.get("ok"):
                         return
-                raise OSError(f"ec_write via osd.{prim}: {got}")
-            except (TimeoutError, OSError, KeyError):
-                if attempt + 1 == retries:
-                    raise
-                time.sleep(0.3)
-                self.refresh_map()
+                    if got.get("error") == "not primary" and \
+                            got.get("primary") in self.osd_addrs:
+                        got = self.msgr.call(
+                            self.osd_addrs[got["primary"]],
+                            {"type": "ec_write", "pool": pool_id,
+                             "ps": ps, "oid": oid, "offset": offset,
+                             "data": bytes(data), "v": v},
+                            timeout=15)
+                        if got.get("ok"):
+                            return
+                    raise OSError(f"ec_write via osd.{prim}: {got}")
+                except (TimeoutError, OSError, KeyError):
+                    if attempt + 1 == retries:
+                        raise
+                    op.mark_event(f"retry {attempt + 1}")
+                    time.sleep(0.3)
+                    self.refresh_map()
 
     def _first_reachable(self, up):
         """The routing invariant: first up, addressable, non-NONE
@@ -347,25 +407,27 @@ class Client(MapFollower):
         """Tombstoned delete: peering propagates it over older writes
         (the reference's log-entry DELETE semantics)."""
         v = make_version(self.epoch)
-        for attempt in range(retries):
-            try:
-                pool, ps, up = self._up(pool_id, oid)
-                for osd in {o for o in up
-                            if o >= 0 and o in self.osd_addrs}:
-                    got = self.msgr.call(
-                        self.osd_addrs[osd],
-                        {"type": "obj_delete", "pool": pool_id,
-                         "ps": ps, "oid": oid, "v": v,
-                         "restamp": True}, timeout=10)
-                    if not got.get("ok"):
-                        raise OSError(f"obj_delete on osd.{osd}: "
-                                      f"{got}")
-                return
-            except (TimeoutError, OSError, KeyError):
-                if attempt + 1 == retries:
-                    raise
-                time.sleep(0.3)
-                self.refresh_map()
+        with self._op("delete", pool_id, oid) as (_span, op):
+            for attempt in range(retries):
+                try:
+                    pool, ps, up = self._up(pool_id, oid)
+                    for osd in {o for o in up
+                                if o >= 0 and o in self.osd_addrs}:
+                        got = self.msgr.call(
+                            self.osd_addrs[osd],
+                            {"type": "obj_delete", "pool": pool_id,
+                             "ps": ps, "oid": oid, "v": v,
+                             "restamp": True}, timeout=10)
+                        if not got.get("ok"):
+                            raise OSError(f"obj_delete on osd.{osd}: "
+                                          f"{got}")
+                    return
+                except (TimeoutError, OSError, KeyError):
+                    if attempt + 1 == retries:
+                        raise
+                    op.mark_event(f"retry {attempt + 1}")
+                    time.sleep(0.3)
+                    self.refresh_map()
 
     def _read_ec(self, pool_id, ps, oid, up, code) -> bytes:
         """Gather any k shards (degraded reads ride the same path the

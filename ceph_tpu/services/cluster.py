@@ -12,6 +12,8 @@ provides in the reference.
 
 from __future__ import annotations
 
+import shutil
+import tempfile
 import time
 from typing import Dict, List, Optional
 
@@ -36,6 +38,10 @@ class MiniCluster:
         # when set, OSDs persist their stores under data_dir/osd<N>
         # and restarts remount instead of backfilling from scratch
         self.data_dir = data_dir
+        # every daemon's admin socket binds under one per-cluster dir
+        # (kept short: AF_UNIX paths cap at ~108 bytes) — the dir the
+        # telemetry tool polls for the whole-cluster snapshot
+        self.asok_dir = tempfile.mkdtemp(prefix="ceph-tpu-asok-")
         self.n_osds = n_osds
         hosts = hosts or n_osds
         # crush hierarchy through the facade (one host per fd bucket)
@@ -74,7 +80,8 @@ class MiniCluster:
             import os
 
             mon_store = os.path.join(self.data_dir, f"mon{rank}")
-        ctx = Context(f"mon.{rank}", config=self.conf)
+        ctx = Context(f"mon.{rank}", config=self.conf,
+                      admin_dir=self.asok_dir)
         return Monitor(ctx, OSDMap.from_dict(
             self._mon_osdmap.to_dict()), keyring=self.keyring,
             store_dir=mon_store, port=port)
@@ -96,9 +103,13 @@ class MiniCluster:
             svc.shutdown()
         for mon in self.mons.values():
             mon.shutdown()
+        shutil.rmtree(self.asok_dir, ignore_errors=True)
 
     def client(self, name: str = "admin") -> Client:
-        c = Client(name, self.mon_addrs, keyring=self.keyring)
+        ctx = Context(f"client.{name}", config=self.conf,
+                      admin_dir=self.asok_dir)
+        c = Client(name, self.mon_addrs, keyring=self.keyring,
+                   ctx=ctx)
         self.clients.append(c)
         return c
 
@@ -109,7 +120,7 @@ class MiniCluster:
                 return mon
         return None
 
-    def wait_for_quorum(self, timeout: float = 15.0) -> Monitor:
+    def wait_for_quorum(self, timeout: float = 30.0) -> Monitor:
         """Wait for the STEADY-STATE leader: the lowest live rank, with
         genesis committed.  (A higher rank can win a first round and
         lead transiently until the lowest reachable rank's candidacy
@@ -232,7 +243,8 @@ class MiniCluster:
             svc.shutdown()
 
     def revive_osd(self, osd: int) -> OSDService:
-        ctx = Context(f"osd.{osd}", config=self.conf)
+        ctx = Context(f"osd.{osd}", config=self.conf,
+                      admin_dir=self.asok_dir)
         data_dir = None
         if self.data_dir is not None:
             import os

@@ -19,8 +19,18 @@ from __future__ import annotations
 import time
 from typing import Dict
 
+from ..common.perf_counters import collection
 from ..osdmap.incremental import Incremental, apply_incremental
 from ..osdmap.osdmap import OSDMap
+
+# process-global scalar-mapping metrics: every daemon's data path asks
+# pg_up_acting per op, so lookup volume, cache efficacy, and walk
+# latency live here (served via each daemon's merged `perf dump`)
+_pc = collection().create("crush.scalar")
+_pc.add_u64_counter("pg_lookups")
+_pc.add_u64_counter("cache_hits")
+_pc.add_time("map_time")
+_pc.add_histogram("map_lat")
 
 
 class MonError(RuntimeError):
@@ -121,15 +131,21 @@ class MapFollower:
         copy-apply-swap (never mutated in place), so caching per
         installed map object is sound.  Cleared on every swap."""
         key = (pool_id, ps)
+        _pc.inc("pg_lookups")
         with self._lock:
             cache = getattr(self, "_pg_cache", None)
             if cache is None:
                 cache = self._pg_cache = {}
             hit = cache.get(key)
             if hit is not None:
+                _pc.inc("cache_hits")
                 return hit
             m = self.map
+        t0 = time.monotonic()
         val = m.pg_to_up_acting_osds(pool_id, ps)
+        dt = time.monotonic() - t0
+        _pc.tinc("map_time", dt)
+        _pc.hist_add("map_lat", dt)
         with self._lock:
             if self.map is m:
                 if len(cache) > 65536:

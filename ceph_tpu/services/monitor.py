@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..analysis.lockdep import make_lock, make_rlock
 from ..common.context import Context
+from ..common.op_tracker import OpTracker
 from ..msg.messenger import Addr, Messenger
 from ..osdmap.osdmap import OSDMap, PgPool
 from .quorum import Quorum
@@ -41,10 +42,12 @@ class Monitor:
         self.ctx = ctx
         self.log = ctx.logger("mon")
         self.map = osdmap
+        self.tracer = ctx.tracer
         # lossless policy: mon↔mon quorum traffic and mon↔osd control
         # frames are sequenced and replayed across reconnects
         self.msgr = Messenger("mon", host, port, keyring=keyring,
-                              lossless=True)
+                              lossless=True, tracer=self.tracer,
+                              perf=ctx.perf)
         self.addr: Addr = self.msgr.addr
         self.store_dir = store_dir
         self._epochs: Dict[int, str] = {}  # epoch -> map json
@@ -71,6 +74,11 @@ class Monitor:
         self.pc.add_u64_counter("epochs")
         self.pc.add_u64_counter("beats")
         self.pc.add_u64_counter("markdowns")
+        self.pc.add_histogram("commit_lat")
+        self.pc.add_time("commit_time")
+        # write commands register here (the leader-side op surface);
+        # dump_ops_in_flight / dump_historic_ops over the admin socket
+        self.optracker = OpTracker()
 
         # write commands mutate the map: leader-only in quorum mode
         # (forwarded there); reads are served by any member
@@ -115,7 +123,11 @@ class Monitor:
         def h(msg: Dict):
             q = self.quorum
             if q is None or q.is_leader():
-                return handler(msg)
+                with self.optracker.create(
+                        "mon_cmd",
+                        f"{msg.get('type', '?')} from "
+                        f"{msg.get('frm', '?')}"):
+                    return handler(msg)
             la = q.leader_addr()
             if la is None:
                 return {"error": "no quorum"}
@@ -190,6 +202,10 @@ class Monitor:
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> None:
+        if self.ctx.conf["admin_socket"]:
+            sock = self.ctx.start_admin_socket()
+            self.optracker.wire(sock)
+            self.tracer.wire(sock)
         self._load_store()
         self.msgr.start()
         self._running = True
@@ -245,6 +261,7 @@ class Monitor:
         for p in self._pushers.values():
             p.stop()
         self.msgr.shutdown()
+        self.ctx.shutdown()  # admin socket + config observers
 
     # -- the epoch store (MonitorDBStore role) --------------------------
     def _commit(self, why: str) -> int:
@@ -254,6 +271,7 @@ class Monitor:
         majority rolls back and abdicates, so epochs never fork."""
         from ..osdmap.incremental import diff_maps
 
+        t_commit = time.monotonic()
         with self._commit_serial:
             with self._lock:
                 self.map.epoch += 1
@@ -273,6 +291,9 @@ class Monitor:
                         "mon: lost quorum; commit aborted")
             self._store_committed(v, payload, inc_d)
         self.pc.inc("epochs")
+        dt = time.monotonic() - t_commit
+        self.pc.hist_add("commit_lat", dt)
+        self.pc.tinc("commit_time", dt)
         self.log.dout(5, f"new epoch {v} ({why})")
         self._push_maps()
         return v

@@ -73,11 +73,14 @@ class OSDService(MapFollower):
         # lossless policy (osd↔osd sub-ops survive reconnects) and the
         # per-type byte throttle bounding in-flight client write bytes
         # (the osd_client_message_size_cap role, ceph_osd.cc:582-588)
+        self.tracer = ctx.tracer  # shared with the messenger: handler
+        # spans parent service spans (ec.encode under handle:ec_write)
         self.msgr = Messenger(
             f"osd.{osd_id}", host, port, keyring=keyring,
             lossless=True,
             throttles={"shard_write": Throttle(
-                "msgr-write-bytes", 64 << 20)})
+                "msgr-write-bytes", 64 << 20)},
+            tracer=self.tracer, perf=ctx.perf)
         self.addr = self.msgr.addr
         self.map: Optional[OSDMap] = None
         self.epoch = 0
@@ -174,6 +177,13 @@ class OSDService(MapFollower):
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
+        if self.ctx.conf["admin_socket"]:
+            # the daemon's introspection plane: perf dump (own +
+            # shared library counters), dump_tracing, op tracker,
+            # dump_blocked — what ceph_tpu.tools.telemetry polls
+            sock = self.ctx.start_admin_socket()
+            self.optracker.wire(sock)
+            self.tracer.wire(sock)
         self.msgr.start()
         self._running = True
         boot = self.mon_call({"type": "boot", "osd": self.id,
@@ -199,6 +209,7 @@ class OSDService(MapFollower):
             pool.shutdown(wait=False)
         self.sched.shutdown()
         self.msgr.shutdown()
+        self.ctx.shutdown()  # admin socket + config observers
         try:
             self._flush()
         except OSError as e:
@@ -494,13 +505,17 @@ class OSDService(MapFollower):
                 v = bump(curb.decode())
             targets = [o for o in dict.fromkeys(members)
                        if o >= 0 and (o == self.id or self._alive(o))]
+            # fan-out workers adopt this handler's span so every
+            # replica push joins the op's trace
+            parent_span = self.tracer.current()
             for _restamp in range(3):
                 replies: Dict[int, Optional[Dict]] = {}
 
                 def push(o):
-                    replies[o] = self._push_shard(
-                        pool_id, ps, o, oid, 0, data, len(data), v,
-                        qos="client")
+                    with self.tracer.scope(parent_span):
+                        replies[o] = self._push_shard(
+                            pool_id, ps, o, oid, 0, data, len(data),
+                            v, qos="client")
 
                 others = [o for o in targets if o != self.id]
                 futs = [self._fanout().submit(push, o)
@@ -598,9 +613,15 @@ class OSDService(MapFollower):
                     v = bump(curb.decode())
             n = code.get_chunk_count()
             k = code.get_data_chunk_count()
-            chunks = code.encode(range(n), bytes(buf))
-            payloads = [np.asarray(chunks[p], np.uint8).tobytes()
-                        for p in range(n)]
+            # traced as a child of handle:ec_write when the client op
+            # carries trace context — the per-stage latency the EC
+            # characterization literature needs visible
+            with self.tracer.start_span(
+                    "ec.encode", require_parent=True,
+                    tags={"bytes": len(buf), "k": k, "m": n - k}):
+                chunks = code.encode(range(n), bytes(buf))
+                payloads = [np.asarray(chunks[p], np.uint8).tobytes()
+                            for p in range(n)]
             # distribute; a `superseded` reply means some holder has a
             # NEWER stored version our floor probe missed (our own
             # shard degraded) — counting it as landed would ack a

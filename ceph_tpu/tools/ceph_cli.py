@@ -5,10 +5,15 @@ status/health/df, osd tree/reweight/out/down, pool create/delete/ls —
 all against a running cluster's monitor address (quorum lists accepted
 as comma-separated host:port pairs).
 
+Plus the local observability plane (no monitor needed — polls daemon
+admin sockets, ceph_tpu/tools/telemetry.py):
+
 CLI:
     python -m ceph_tpu.tools.ceph_cli --mon HOST:PORT[,HOST:PORT...] \
         status | health | osd tree | osd reweight ID W | osd out ID |
         osd down ID | pool ls | pool create ID PGS SIZE | pool delete ID
+    python -m ceph_tpu.tools.ceph_cli --asok-dir DIR \
+        daemonperf | telemetry snapshot|prom|traces
 """
 
 from __future__ import annotations
@@ -31,12 +36,40 @@ def _mons(spec: str):
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ceph")
-    ap.add_argument("--mon", required=True,
+    ap.add_argument("--mon",
                     help="monitor address(es), host:port[,host:port]")
+    ap.add_argument("--asok-dir",
+                    help="daemon admin-socket dir (daemonperf / "
+                         "telemetry verbs)")
     ap.add_argument("--keyring", help="cluster key (hex)")
     ap.add_argument("verb", nargs="+")
-    args = ap.parse_args(argv)
+    # unknown extras (e.g. daemonperf's --interval/--count) pass
+    # through to the telemetry tool's own parser
+    args, extra = ap.parse_known_args(argv)
 
+    # the observability verbs poll admin sockets directly — no
+    # monitor, no messenger
+    if args.verb[0] in ("daemonperf", "telemetry"):
+        from . import telemetry
+
+        if not args.asok_dir:
+            print("daemonperf/telemetry need --asok-dir",
+                  file=sys.stderr)
+            return 2
+        sub = args.verb[1] if args.verb[0] == "telemetry" and \
+            len(args.verb) > 1 else (
+                "daemonperf" if args.verb[0] == "daemonperf"
+                else "snapshot")
+        return telemetry.main(["--asok-dir", args.asok_dir, sub]
+                              + args.verb[2:] + extra)
+
+    if extra:
+        print(f"unrecognized arguments: {' '.join(extra)}",
+              file=sys.stderr)
+        return 2
+    if not args.mon:
+        print("this verb needs --mon", file=sys.stderr)
+        return 2
     kr = None
     if args.keyring:
         from ..msg.auth import Keyring

@@ -1,0 +1,326 @@
+"""telemetry — the cluster-wide observability aggregator.
+
+The mgr-prometheus-module + ``ceph daemonperf`` role: poll every
+daemon's admin socket (one ``*.asok`` per daemon under the cluster's
+asok dir — MiniCluster binds them there automatically), merge each
+``perf dump`` / ``dump_tracing`` / ``dump_ops_in_flight`` into one
+cluster snapshot, and render it three ways:
+
+- Prometheus text exposition (``prom``): every counter/gauge/time as a
+  sample labeled {daemon, logger}; avg pairs as _sum/_count; log2
+  latency histograms as cumulative _bucket{le=...} series.
+- a ``ceph daemonperf``-style columnar view (``daemonperf``): per-
+  daemon per-second rates between two polls.
+- cross-daemon trace reassembly (``traces``): spans from every
+  daemon's ring buffer grouped by trace_id and re-parented into one
+  tree — the client → messenger → primary OSD → EC encode → shard
+  fan-out picture of a single op.
+
+CLI:
+    python -m ceph_tpu.tools.telemetry --asok-dir DIR \
+        snapshot | prom | daemonperf [--interval S] [--count N] | \
+        traces [--trace-id ID] [--root NAME]
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import glob
+import json
+import os
+import re
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..common.admin_socket import AdminSocket
+
+
+# -- polling ----------------------------------------------------------
+
+def discover(asok_dir: str) -> Dict[str, str]:
+    """{daemon name: socket path} for every *.asok under the dir."""
+    out = {}
+    for path in sorted(glob.glob(os.path.join(asok_dir, "*.asok"))):
+        out[os.path.basename(path)[:-len(".asok")]] = path
+    return out
+
+
+def poll_daemon(path: str, timeout: float = 5.0) -> Optional[Dict]:
+    """One daemon's observability payload; None when unreachable (a
+    dead daemon must not break the cluster snapshot)."""
+    out: Dict = {}
+    for key, prefix in (("perf", "perf dump"),
+                        ("tracing", "dump_tracing"),
+                        ("ops_in_flight", "dump_ops_in_flight"),
+                        ("historic_ops", "dump_historic_ops")):
+        try:
+            got = AdminSocket.request(path, prefix, timeout=timeout)
+        except (OSError, ValueError):
+            if not out:
+                return None
+            continue
+        if isinstance(got, dict) and "error" in got and len(got) <= 2:
+            continue  # command not wired on this daemon
+        out[key] = got
+    return out or None
+
+
+def cluster_snapshot(asok_dir: Optional[str] = None,
+                     paths: Optional[Dict[str, str]] = None,
+                     timeout: float = 5.0) -> Dict:
+    """Poll every daemon once; unreachable daemons are listed, not
+    fatal."""
+    assert asok_dir is not None or paths is not None
+    targets = dict(paths or {})
+    if asok_dir is not None:
+        targets = {**discover(asok_dir), **targets}
+    daemons, dead = {}, []
+    for name, path in sorted(targets.items()):
+        got = poll_daemon(path, timeout=timeout)
+        if got is None:
+            dead.append(name)
+        else:
+            daemons[name] = got
+    return {"ts": time.time(), "daemons": daemons,
+            "unreachable": dead}
+
+
+# -- prometheus text exposition ---------------------------------------
+
+def _sanitize(name: str) -> str:
+    return re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+def to_prometheus(snapshot: Dict, prefix: str = "ceph_tpu") -> str:
+    """Prometheus text format.  Counter types survive the wire only
+    structurally: plain numbers emit as untyped samples, {avgcount,
+    sum} pairs as _sum/_count, {buckets, min} log2 histograms as
+    cumulative _bucket{le=...} + _count (le bounds are min * 2^i —
+    bucket 0 is everything <= min)."""
+    lines: List[str] = []
+    for daemon, data in sorted(snapshot.get("daemons", {}).items()):
+        for logger, counters in sorted((data.get("perf")
+                                        or {}).items()):
+            if not isinstance(counters, dict):
+                continue
+            labels = (f'daemon="{daemon}",logger="{logger}"')
+            for key, val in sorted(counters.items()):
+                metric = f"{prefix}_{_sanitize(key)}"
+                if isinstance(val, dict) and "buckets" in val:
+                    lo = float(val.get("min", 1.0))
+                    cum = 0
+                    for i, n in enumerate(val["buckets"]):
+                        cum += n
+                        lines.append(
+                            f'{metric}_bucket{{{labels},'
+                            f'le="{lo * (2.0 ** i):.9g}"}} {cum}')
+                    lines.append(f'{metric}_bucket{{{labels},'
+                                 f'le="+Inf"}} {cum}')
+                    lines.append(f"{metric}_count{{{labels}}} {cum}")
+                elif isinstance(val, dict) and "avgcount" in val:
+                    lines.append(f"{metric}_sum{{{labels}}} "
+                                 f"{val.get('sum', 0)}")
+                    lines.append(f"{metric}_count{{{labels}}} "
+                                 f"{val.get('avgcount', 0)}")
+                elif isinstance(val, (int, float)):
+                    lines.append(f"{metric}{{{labels}}} {val}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# -- daemonperf (columnar rates between two polls) --------------------
+
+# (logger glob, counter key, column header) — summed over matching
+# loggers per daemon, rendered as per-second rates
+DEFAULT_COLUMNS: List[Tuple[str, str, str]] = [
+    ("msgr.*", "bytes_in", "rx_B/s"),
+    ("msgr.*", "bytes_out", "tx_B/s"),
+    ("msgr.*", "frames_in", "rxf/s"),
+    ("osd.*", "ops_w", "wr/s"),
+    ("osd.*", "ops_r", "rd/s"),
+    ("client.*", "ops_put", "put/s"),
+    ("client.*", "ops_get", "get/s"),
+    ("mon*", "epochs", "epo/s"),
+]
+
+
+def _column_value(perf: Dict, logger_glob: str, key: str) -> float:
+    total = 0.0
+    for logger, counters in (perf or {}).items():
+        if not fnmatch.fnmatch(logger, logger_glob):
+            continue
+        val = (counters or {}).get(key)
+        if isinstance(val, (int, float)):
+            total += val
+    return total
+
+
+def daemonperf_view(prev: Dict, cur: Dict,
+                    columns: Optional[List[Tuple[str, str, str]]]
+                    = None) -> str:
+    """`ceph daemonperf` analogue: one row per daemon, one column per
+    (logger glob, key), values are deltas/second between the two
+    snapshots."""
+    columns = columns or DEFAULT_COLUMNS
+    dt = max(1e-9, cur.get("ts", 0) - prev.get("ts", 0))
+    headers = [h for _g, _k, h in columns]
+    width = max(8, *(len(h) + 1 for h in headers))
+    name_w = max([len("daemon")] +
+                 [len(d) for d in cur.get("daemons", {})]) + 1
+    lines = ["daemon".ljust(name_w)
+             + "".join(h.rjust(width) for h in headers)]
+    for daemon in sorted(cur.get("daemons", {})):
+        cperf = cur["daemons"][daemon].get("perf") or {}
+        pperf = (prev.get("daemons", {}).get(daemon, {})
+                 .get("perf")) or {}
+        cells = []
+        for lg, key, _h in columns:
+            rate = (_column_value(cperf, lg, key)
+                    - _column_value(pperf, lg, key)) / dt
+            cells.append(f"{rate:.1f}".rjust(width))
+        lines.append(daemon.ljust(name_w) + "".join(cells))
+    return "\n".join(lines)
+
+
+# -- cross-daemon trace reassembly ------------------------------------
+
+def gather_spans(snapshot: Dict,
+                 extra: Optional[List[Dict]] = None) -> List[Dict]:
+    """Every span in the snapshot (finished + active), stamped with
+    the daemon that reported it."""
+    spans: List[Dict] = []
+    for daemon, data in snapshot.get("daemons", {}).items():
+        tr = data.get("tracing") or {}
+        for s in list(tr.get("spans", [])) + list(tr.get("active",
+                                                         [])):
+            spans.append(dict(s, daemon=daemon))
+    for s in extra or []:
+        spans.append(dict(s))
+    return spans
+
+
+def find_trace_ids(spans: List[Dict],
+                   root_name: Optional[str] = None) -> List[str]:
+    """trace_ids that have a ROOT span (optionally named), newest
+    first."""
+    roots = [s for s in spans if not s.get("parent_id")
+             and (root_name is None or s.get("name") == root_name)]
+    roots.sort(key=lambda s: s.get("start", 0), reverse=True)
+    out: List[str] = []
+    for s in roots:
+        if s["trace_id"] not in out:
+            out.append(s["trace_id"])
+    return out
+
+
+def trace_tree(spans: List[Dict], trace_id: str) -> List[Dict]:
+    """Re-parent one trace's spans (from any number of daemons) into
+    a forest: nodes are span dicts with a ``children`` list; spans
+    whose parent was not reported (sampled out, ring-evicted, daemon
+    unreachable) surface as extra roots rather than vanishing."""
+    mine = [s for s in spans if s.get("trace_id") == trace_id]
+    index: Dict[str, Dict] = {}
+    for s in mine:
+        index.setdefault(s["span_id"], dict(s, children=[]))
+    roots: List[Dict] = []
+    for node in index.values():
+        parent = node.get("parent_id")
+        if parent and parent in index:
+            index[parent]["children"].append(node)
+        else:
+            roots.append(node)
+
+    def order(nodes: List[Dict]) -> None:
+        nodes.sort(key=lambda n: n.get("start", 0))
+        for n in nodes:
+            order(n["children"])
+
+    order(roots)
+    return roots
+
+
+def render_trace(roots: List[Dict]) -> str:
+    lines: List[str] = []
+
+    def walk(node: Dict, depth: int) -> None:
+        dur = node.get("duration")
+        dur_s = f"{dur * 1000:.2f}ms" if isinstance(
+            dur, (int, float)) else "?"
+        svc = node.get("daemon") or node.get("service", "?")
+        tags = node.get("tags") or {}
+        tag_s = (" " + json.dumps(tags, sort_keys=True)
+                 ) if tags else ""
+        lines.append(f"{'  ' * depth}{svc}: {node.get('name')} "
+                     f"{dur_s}{tag_s}")
+        for child in node["children"]:
+            walk(child, depth + 1)
+
+    for root in roots:
+        walk(root, 0)
+    return "\n".join(lines)
+
+
+def span_names(roots: List[Dict]) -> List[str]:
+    """Flat preorder list of span names (test/assertion helper)."""
+    out: List[str] = []
+
+    def walk(node: Dict) -> None:
+        out.append(node.get("name"))
+        for child in node["children"]:
+            walk(child)
+
+    for root in roots:
+        walk(root)
+    return out
+
+
+# -- CLI --------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="telemetry")
+    ap.add_argument("--asok-dir", required=True,
+                    help="directory of daemon *.asok sockets")
+    ap.add_argument("cmd", choices=("snapshot", "prom", "traces",
+                                    "daemonperf"))
+    ap.add_argument("--trace-id", help="traces: reassemble this id")
+    ap.add_argument("--root",
+                    help="traces: only traces whose root span has "
+                         "this name")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="daemonperf: seconds between polls")
+    ap.add_argument("--count", type=int, default=1,
+                    help="daemonperf: rows of rates to print")
+    args = ap.parse_args(argv)
+
+    snap = cluster_snapshot(args.asok_dir)
+    if not snap["daemons"]:
+        print(f"no reachable daemons under {args.asok_dir}",
+              file=sys.stderr)
+        return 1
+    if args.cmd == "snapshot":
+        print(json.dumps(snap, indent=1, default=str))
+    elif args.cmd == "prom":
+        sys.stdout.write(to_prometheus(snap))
+    elif args.cmd == "traces":
+        spans = gather_spans(snap)
+        ids = [args.trace_id] if args.trace_id else \
+            find_trace_ids(spans, args.root)
+        if not ids:
+            print("no traces found", file=sys.stderr)
+            return 1
+        for tid in ids:
+            print(f"trace {tid}:")
+            print(render_trace(trace_tree(spans, tid)))
+    elif args.cmd == "daemonperf":
+        prev = snap
+        for _ in range(max(1, args.count)):
+            time.sleep(args.interval)
+            cur = cluster_snapshot(args.asok_dir)
+            print(daemonperf_view(prev, cur))
+            prev = cur
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,6 +55,7 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 GOLDEN_DIR = pathlib.Path(__file__).resolve().parent / "golden"
 
 from ceph_tpu.analysis import lockdep, watchdog  # noqa: E402
+from ceph_tpu.common import tracing  # noqa: E402
 
 
 @pytest.fixture(scope="session", autouse=True)
@@ -77,8 +78,18 @@ def _concurrency_gate(request):
        cross-test interference that made the quorum rejoin test
        flaky) get a grace period to die, then a warning.  Either way
        the NEXT test starts from a quiesced process.
+    3. Span leak: every tracing span opened during the test must be
+       finished by test end (after the thread quiesce above).  A span
+       left open with no daemon thread alive to ever finish it means a
+       code path began a span outside a ``with`` (lint CONC004's
+       runtime twin) or an op died mid-trace — that fails the test,
+       and the spans are abandoned so one leaky test cannot re-fail
+       every later one.  With live daemon threads still draining (a
+       shared cluster fixture's background recovery/heartbeat RPCs),
+       an open span may yet finish — warn, like the thread gate.
     """
     before = set(threading.enumerate())
+    before_spans = {id(s) for _svc, s in tracing.active_spans()}
     base = len(lockdep.violations())
     yield
     vs = lockdep.violations()[base:]
@@ -116,3 +127,33 @@ def _concurrency_gate(request):
             f"{request.node.nodeid} leaked daemon thread(s): "
             f"{sorted(t.name for t in left)[:10]}"
             f"{'...' if len(left) > 10 else ''}")
+
+    # span-leak gate: give in-flight ops a short drain window (the
+    # thread gate above already quiesced daemon threads)
+    def new_spans():
+        return [(svc, s) for svc, s in tracing.active_spans()
+                if id(s) not in before_spans]
+
+    span_deadline = time.monotonic() + 2.0
+    leaked_spans = new_spans()
+    while leaked_spans and time.monotonic() < span_deadline:
+        time.sleep(0.05)
+        leaked_spans = new_spans()
+    if leaked_spans:
+        detail = "\n".join(
+            f"- [{svc}] {s.name} (trace {s.trace_id}, "
+            f"open {time.monotonic() - s._t0:.1f}s, "
+            f"tags {s.tags})"
+            for svc, s in leaked_spans[:20])
+        if left:
+            # live daemon threads may still finish these (background
+            # ops of a shared cluster fixture) — not a proven leak
+            warnings.warn(
+                f"{request.node.nodeid}: {len(leaked_spans)} span(s) "
+                f"still open at test end:\n{detail}")
+        else:
+            tracing.abandon_all_active()
+            pytest.fail(
+                f"{len(leaked_spans)} tracing span(s) left "
+                f"unfinished at test end with no thread alive to "
+                f"finish them:\n{detail}")

@@ -159,6 +159,69 @@ def test_admin_socket_round_trip(tmp_path):
         sock.shutdown()
 
 
+def test_admin_socket_error_plane(tmp_path):
+    """The error plane: unknown command reply, malformed JSON,
+    client disconnect mid-line, undecodable bytes — each must produce
+    a clean reply or a counted serve-loop fault, and the loop must
+    keep serving afterwards."""
+    import socket as pysock
+
+    path = str(tmp_path / "err.asok")
+    sock = AdminSocket(path)
+    sock.register("ping", lambda _a: {"pong": 1}, "ping")
+    sock.start()
+    try:
+        # unknown command: structured error naming what DOES exist
+        rep = AdminSocket.request(path, "bogus")
+        assert "unknown command" in rep["error"]
+        assert "ping" in rep["have"]
+
+        # malformed JSON line: error reply, not a dead connection
+        with pysock.socket(pysock.AF_UNIX,
+                           pysock.SOCK_STREAM) as s:
+            s.settimeout(5)
+            s.connect(path)
+            s.sendall(b"{not json\n")
+            data = b""
+            while not data.endswith(b"\n"):
+                got = s.recv(65536)
+                if not got:
+                    break
+                data += got
+        assert "error" in json.loads(data.decode())
+
+        # undecodable bytes kill that connection's handling inside
+        # the serve loop: errors/last_error populate, loop survives
+        assert sock.errors == 0 and sock.last_error is None
+        with pysock.socket(pysock.AF_UNIX,
+                           pysock.SOCK_STREAM) as s2:
+            s2.settimeout(5)
+            s2.connect(path)
+            s2.sendall(b"\xff\xfe\n")
+            try:
+                s2.recv(65536)
+            except OSError:
+                pass
+        deadline = time.monotonic() + 5
+        while sock.errors == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sock.errors >= 1
+        assert "UnicodeDecodeError" in sock.last_error
+
+        # client disconnect mid-line: the serve loop must survive it
+        # (the truncated request may fault when the reply hits the
+        # closed socket — counted, never fatal)
+        s = pysock.socket(pysock.AF_UNIX, pysock.SOCK_STREAM)
+        s.connect(path)
+        s.sendall(b'{"prefix": "pi')
+        s.close()
+
+        # after every abuse above, a normal request still round-trips
+        assert AdminSocket.request(path, "ping") == {"pong": 1}
+    finally:
+        sock.shutdown()
+
+
 def test_context_wires_everything(tmp_path):
     ctx = Context("testd", admin_dir=str(tmp_path))
     log = ctx.logger("crush")

@@ -142,6 +142,34 @@ def test_logging_or_narrow_runloop_except_ok(tmp_path):
     assert vs == []
 
 
+def test_span_outside_with_flagged(tmp_path):
+    vs = _lint(tmp_path, """
+        def leaky(self):
+            sp = self.tracer.start_span("op")
+            work()
+            sp.finish()
+
+        def assigned_from_call(tracer):
+            return tracer.start_span("escapes")
+    """)
+    assert codes(vs) == ["CONC004", "CONC004"]
+
+
+def test_span_in_with_ok(tmp_path):
+    vs = _lint(tmp_path, """
+        def clean(self):
+            with self.tracer.start_span("op", tags={"x": 1}) as sp:
+                sp.log("phase")
+            with self.tracer.start_span("a") as a, open("f") as f:
+                pass
+
+        def suppressed(self):
+            sp = self.tracer.start_span("op")  # conc-ok: handed to a callback that finishes it
+            return sp
+    """)
+    assert vs == []
+
+
 def test_conc_ok_suppression(tmp_path):
     vs = _lint(tmp_path, """
         import os, threading

@@ -1,0 +1,286 @@
+"""Telemetry-plane tests: the aggregation tool's units (prometheus
+exposition, daemonperf columns, cross-daemon trace reassembly) and the
+end-to-end acceptance flow — one Client.put on a k+m EC pool produces
+ONE trace, reassembled from several daemons' ``dump_tracing``, that
+covers client → messenger → primary OSD → EC encode → shard fan-out,
+with non-zero sub-second latency histograms for messenger dispatch and
+EC encode in the cluster ``perf dump``."""
+
+import json
+import time
+
+import pytest
+
+from ceph_tpu.common.config import Config
+from ceph_tpu.services.cluster import MiniCluster
+from ceph_tpu.tools import telemetry
+
+
+# -- unit: prometheus exposition ---------------------------------------------
+
+def _snap(perf):
+    return {"ts": 100.0, "daemons": {"osd.0": {"perf": perf}},
+            "unreachable": []}
+
+
+def test_prometheus_counters_and_histograms():
+    snap = _snap({"osd.0": {"ops_w": 3,
+                            "lat": {"buckets": [1, 0, 2, 0],
+                                    "min": 1e-6},
+                            "avg_t": {"avgcount": 2, "sum": 5.0,
+                                      "avg": 2.5}}})
+    text = telemetry.to_prometheus(snap)
+    assert ('ceph_tpu_ops_w{daemon="osd.0",logger="osd.0"} 3'
+            in text)
+    # log2 buckets are CUMULATIVE with le = min * 2^i
+    assert ('ceph_tpu_lat_bucket{daemon="osd.0",logger="osd.0",'
+            'le="1e-06"} 1') in text
+    assert ('ceph_tpu_lat_bucket{daemon="osd.0",logger="osd.0",'
+            'le="4e-06"} 3') in text
+    assert ('ceph_tpu_lat_bucket{daemon="osd.0",logger="osd.0",'
+            'le="+Inf"} 3') in text
+    assert 'ceph_tpu_lat_count{daemon="osd.0",logger="osd.0"} 3' \
+        in text
+    assert 'ceph_tpu_avg_t_sum{daemon="osd.0",logger="osd.0"} 5.0' \
+        in text
+    assert ('ceph_tpu_avg_t_count{daemon="osd.0",logger="osd.0"} 2'
+            in text)
+
+
+def test_daemonperf_rates():
+    prev = {"ts": 10.0, "daemons": {
+        "osd.0": {"perf": {"msgr.osd.0": {"bytes_in": 100,
+                                          "bytes_out": 0,
+                                          "frames_in": 1},
+                           "osd.0": {"ops_w": 0, "ops_r": 0}}}}}
+    cur = {"ts": 12.0, "daemons": {
+        "osd.0": {"perf": {"msgr.osd.0": {"bytes_in": 300,
+                                          "bytes_out": 50,
+                                          "frames_in": 5},
+                           "osd.0": {"ops_w": 4, "ops_r": 2}}}}}
+    view = telemetry.daemonperf_view(prev, cur)
+    lines = view.splitlines()
+    assert "rx_B/s" in lines[0] and "wr/s" in lines[0]
+    row = lines[1].split()
+    assert row[0] == "osd.0"
+    assert "100.0" in row  # (300-100)/2s
+    assert "2.0" in row    # ops_w 4/2s
+
+
+# -- unit: trace reassembly --------------------------------------------------
+
+def _span(sid, parent, name, service, start, trace="t1"):
+    return {"trace_id": trace, "span_id": sid, "parent_id": parent,
+            "name": name, "service": service, "start": start,
+            "duration": 0.01, "finished": True, "tags": {},
+            "events": []}
+
+
+def test_trace_reassembly_across_daemons():
+    snap = {"ts": 0, "unreachable": [], "daemons": {
+        "client.a": {"tracing": {"spans": [
+            _span("s1", None, "client.put", "client.a", 1.0),
+            _span("s2", "s1", "call:ec_write", "client.a", 1.1)],
+            "active": []}},
+        "osd.0": {"tracing": {"spans": [
+            _span("s3", "s2", "handle:ec_write", "osd.0", 1.2),
+            _span("s4", "s3", "ec.encode", "osd.0", 1.3),
+            _span("s5", "s3", "call:shard_write", "osd.0", 1.4)],
+            "active": []}},
+        "osd.1": {"tracing": {"spans": [
+            _span("s6", "s5", "handle:shard_write", "osd.1", 1.5),
+            _span("zz", None, "unrelated", "osd.1", 9.0,
+                  trace="t2")], "active": []}},
+    }}
+    spans = telemetry.gather_spans(snap)
+    assert telemetry.find_trace_ids(spans, "client.put") == ["t1"]
+    roots = telemetry.trace_tree(spans, "t1")
+    assert len(roots) == 1
+    names = telemetry.span_names(roots)
+    assert names == ["client.put", "call:ec_write",
+                     "handle:ec_write", "ec.encode",
+                     "call:shard_write", "handle:shard_write"]
+    text = telemetry.render_trace(roots)
+    # indentation reflects depth; daemon names label each line
+    assert "client.a: client.put" in text
+    assert "    osd.0: ec.encode" in text
+    # an orphaned span (parent not reported) surfaces as a root
+    orphan_roots = telemetry.trace_tree(
+        [s for s in spans if s["span_id"] != "s5"
+         and s["trace_id"] == "t1"], "t1")
+    assert {r["name"] for r in orphan_roots} == \
+        {"client.put", "handle:shard_write"}
+
+
+# -- integration: the acceptance flow ----------------------------------------
+
+@pytest.fixture(scope="module")
+def ec_cluster():
+    conf = Config()
+    conf.set("osd_heartbeat_interval", 0.2)
+    conf.set("osd_heartbeat_grace", 1.0)
+    cl = MiniCluster(n_osds=3, config=conf).start()
+    # w=16 rides the jitted bit-plane engine (w=8 would take the
+    # native GF table path): exercises the JIT-compile/steady-state
+    # split the EC perf counters are asserted on below
+    cl.create_ec_pool(2, "k2m1", {"plugin": "jerasure",
+                                  "technique": "reed_sol_van",
+                                  "k": "2", "m": "1", "w": "16"},
+                      pg_num=4)
+    yield cl
+    cl.shutdown()
+
+
+def test_ec_put_trace_spans_cluster(ec_cluster):
+    """One Client.put on a k=2,m=1 EC pool -> ONE trace whose
+    reassembled tree (from every daemon's dump_tracing over the admin
+    socket) covers client -> messenger call -> primary OSD ec_write ->
+    EC encode -> shard-write fanout -> replica OSDs, spanning >= 3
+    daemons."""
+    c = ec_cluster.client("trace")
+    data = bytes(range(256)) * 16
+    c.put(2, "traced-obj", data)
+    # second identical put: the EC kernel's first call books as JIT
+    # compile; the steady-state encode must land in the latency hist
+    c.put(2, "traced-obj", data)
+    assert c.get(2, "traced-obj") == data
+
+    snap = telemetry.cluster_snapshot(ec_cluster.asok_dir)
+    # every daemon answered: 1 mon + 3 osds + the client
+    names = set(snap["daemons"])
+    assert {"mon.0", "osd.0", "osd.1", "osd.2",
+            "client.trace"} <= names
+    assert snap["unreachable"] == []
+
+    spans = telemetry.gather_spans(snap)
+    tids = telemetry.find_trace_ids(spans, "client.put")
+    assert tids, "no client.put root span reached the ring"
+    tree = None
+    for tid in tids:  # newest trace first; both puts qualify
+        roots = telemetry.trace_tree(spans, tid)
+        if "ec.encode" in telemetry.span_names(roots):
+            tree = roots
+            break
+    assert tree is not None, "no put trace reached ec.encode"
+    names = telemetry.span_names(tree)
+    assert names[0] == "client.put"
+    for stage in ("call:ec_write", "handle:ec_write", "ec.encode",
+                  "call:shard_write", "handle:shard_write"):
+        assert stage in names, f"trace missing stage {stage}"
+
+    # the chain crosses >= 3 daemons' rings (client + primary +
+    # replica(s))
+    daemons_in_trace = set()
+
+    def walk(node):
+        daemons_in_trace.add(node["daemon"])
+        for ch in node["children"]:
+            walk(ch)
+
+    for r in tree:
+        walk(r)
+    assert len(daemons_in_trace) >= 3, daemons_in_trace
+    # the encode happened on the PRIMARY osd, a different daemon from
+    # the client; the shard fanout landed on replicas
+    handle_daemons = {n["daemon"] for n in _flatten(tree)
+                      if n["name"] == "handle:shard_write"}
+    assert handle_daemons and "client.trace" not in handle_daemons
+
+
+def _flatten(nodes):
+    out = []
+    for n in nodes:
+        out.append(n)
+        out.extend(_flatten(n["children"]))
+    return out
+
+
+def _subsecond_nonzero(hist):
+    """Any count in a bucket whose upper bound is < 1 s (log2 buckets
+    anchored at ``min``)."""
+    lo = hist.get("min", 1e-6)
+    return any(n for i, n in enumerate(hist["buckets"])
+               if n and lo * (2.0 ** i) < 1.0)
+
+
+def test_cluster_perf_dump_histograms(ec_cluster):
+    """Cluster perf dump: messenger dispatch and EC encode latency
+    histograms resolve sub-second (the hist_add log2-bucketing fix);
+    the EC kernel's compile cost books separately."""
+    snap = telemetry.cluster_snapshot(ec_cluster.asok_dir)
+    dispatch_ok = encode_ok = False
+    compile_seen = False
+    for daemon, d in snap["daemons"].items():
+        for logger, counters in (d.get("perf") or {}).items():
+            if logger.startswith("msgr.") and "dispatch_lat" in \
+                    counters:
+                dispatch_ok |= _subsecond_nonzero(
+                    counters["dispatch_lat"])
+            if logger == "ec.engine":
+                if "encode_lat" in counters:
+                    encode_ok |= _subsecond_nonzero(
+                        counters["encode_lat"])
+                compile_seen |= counters.get("jit_compiles", 0) > 0
+    assert dispatch_ok, "no sub-second messenger dispatch latency"
+    assert encode_ok, "no sub-second steady-state EC encode latency"
+    assert compile_seen, "EC kernel compile count not recorded"
+    # prometheus exposition of the full snapshot stays well-formed
+    text = telemetry.to_prometheus(snap)
+    assert "ceph_tpu_dispatch_lat_bucket{" in text
+    assert "ceph_tpu_encode_lat_bucket{" in text
+
+
+def test_daemonperf_live_rates(ec_cluster):
+    c = ec_cluster.client("perfview")
+    prev = telemetry.cluster_snapshot(ec_cluster.asok_dir)
+    for i in range(3):
+        c.put(2, f"dp-{i}", b"z" * 512)
+    time.sleep(0.1)
+    cur = telemetry.cluster_snapshot(ec_cluster.asok_dir)
+    view = telemetry.daemonperf_view(prev, cur)
+    lines = view.splitlines()
+    assert lines[0].split()[0] == "daemon"
+    rows = {ln.split()[0]: ln for ln in lines[1:]}
+    assert "client.perfview" in rows and "osd.0" in rows
+    # the client pushed bytes somewhere: its tx rate is non-zero
+    tx_col = lines[0].split().index("tx_B/s")
+    assert float(rows["client.perfview"].split()[tx_col]) > 0
+
+
+def test_telemetry_cli_and_ceph_cli(ec_cluster, capsys):
+    assert telemetry.main(["--asok-dir", ec_cluster.asok_dir,
+                           "prom"]) == 0
+    out = capsys.readouterr().out
+    assert "ceph_tpu_" in out
+    assert telemetry.main(["--asok-dir", ec_cluster.asok_dir,
+                           "traces", "--root", "client.put"]) == 0
+    out = capsys.readouterr().out
+    assert "client.put" in out
+    # surfaced through the ceph CLI (no --mon needed)
+    from ceph_tpu.tools.ceph_cli import main as ceph_main
+
+    assert ceph_main(["--asok-dir", ec_cluster.asok_dir,
+                      "telemetry", "snapshot"]) == 0
+    snap = json.loads(capsys.readouterr().out)
+    assert "daemons" in snap
+    assert ceph_main(["telemetry"]) == 2  # needs --asok-dir
+
+
+def test_dump_tracing_admin_command_filters(ec_cluster):
+    """dump_tracing over the admin socket honors trace_id and limit."""
+    from ceph_tpu.common.admin_socket import AdminSocket
+    import os
+
+    c = ec_cluster.client("filterer")
+    c.put(2, "filt-obj", b"q" * 256)
+    path = os.path.join(ec_cluster.asok_dir, "client.filterer.asok")
+    full = AdminSocket.request(path, "dump_tracing")
+    assert full["service"] == "client.filterer"
+    roots = [s for s in full["spans"] if s["name"] == "client.put"]
+    assert roots
+    tid = roots[-1]["trace_id"]
+    only = AdminSocket.request(path, "dump_tracing", trace_id=tid)
+    assert only["spans"] and all(s["trace_id"] == tid
+                                 for s in only["spans"])
+    one = AdminSocket.request(path, "dump_tracing", limit=1)
+    assert len(one["spans"]) == 1

@@ -25,6 +25,13 @@ CONC003  an except clause in a thread run-loop (a function containing
          an exception class its narrow except missed, no log, a stale
          connection leaked (messenger.py reader, ADVICE low #2).
 
+CONC004  a ``start_span(...)`` call whose result is not the context
+         expression of a ``with`` statement.  A manually begin/end'd
+         span leaks on any exception path between begin and end —
+         exactly what the per-test span-leak gate
+         (tests/conftest.py) then fails; ``with
+         tracer.start_span(...) as sp:`` finishes on every path.
+
 Suppression: append ``# conc-ok: <reason>`` to the offending line (or
 the ``with``/``except``/``def`` line introducing it).  The reason is
 mandatory — it is the allowlist entry.
@@ -140,6 +147,8 @@ class _FileLinter(ast.NodeVisitor):
         self.lines = src.splitlines()
         self.out: List[Violation] = []
         self._with_lock_stack: List[int] = []  # lineno of lock withs
+        self._span_with_ok: set = set()  # id() of start_span calls
+        # that ARE a with-item context expression
 
     def _emit(self, code: str, node: ast.AST, message: str,
               *extra_lines: int) -> None:
@@ -163,10 +172,25 @@ class _FileLinter(ast.NodeVisitor):
                 f"lock is held (with-block at line "
                 f"{self._with_lock_stack[-1]})",
                 self._with_lock_stack[-1])
+        # -- CONC004 --------------------------------------------------
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "start_span" \
+                and id(node) not in self._span_with_ok:
+            self._emit(
+                "CONC004", node,
+                "span opened outside a with statement leaks on any "
+                "exception path; use `with ....start_span(...) as "
+                "sp:`")
         self.generic_visit(node)
 
     # -- CONC002 scope tracking --------------------------------------
     def visit_With(self, node: ast.With) -> None:
+        for item in node.items:
+            ce = item.context_expr
+            if isinstance(ce, ast.Call) and \
+                    isinstance(ce.func, ast.Attribute) and \
+                    ce.func.attr == "start_span":
+                self._span_with_ok.add(id(ce))
         lockish = any(_is_lockish(item.context_expr)
                       for item in node.items)
         for item in node.items:
