@@ -29,6 +29,16 @@ Architecture (the "a number ALWAYS lands" contract), staged:
 
 Deadlines (seconds, env-overridable):
   CEPH_TPU_BENCH_TPU_DEADLINE   (default 300) — whole accel worker
+  CEPH_TPU_BENCH_INIT_DEADLINE  (default 60) — accel BACKEND INIT
+                                 probe: the worker's first emitted
+                                 line is its backend-init timestamp;
+                                 if it hasn't landed by this deadline
+                                 the backend is hung (TPU tunnel
+                                 down), and waiting out the full
+                                 worker deadline would burn 300 s to
+                                 learn nothing — fail fast, record
+                                 ``backend_init_failed`` in the JSON,
+                                 and let the CPU figure own the line.
   CEPH_TPU_BENCH_CPU_DEADLINE   (default 270)
   CEPH_TPU_BENCH_EC_DEADLINE    (default 150) — extra EC wait after
                                  the headline printed
@@ -48,6 +58,8 @@ CPU_BASELINE_MAPPINGS_PER_SEC = json.load(
     open(REPO / "BASELINE_MEASURED.json"))["crush_mappings_per_sec_cpu"]
 
 TPU_DEADLINE = float(os.environ.get("CEPH_TPU_BENCH_TPU_DEADLINE", 300))
+INIT_DEADLINE = float(os.environ.get("CEPH_TPU_BENCH_INIT_DEADLINE",
+                                     60))
 CPU_DEADLINE = float(os.environ.get("CEPH_TPU_BENCH_CPU_DEADLINE", 270))
 EC_DEADLINE = float(os.environ.get("CEPH_TPU_BENCH_EC_DEADLINE", 150))
 
@@ -471,14 +483,22 @@ def main():
         r.get("map") == "map_big10k"  # noqa: E731
 
     acc_big = acc_tiny = None
+    backend_init_failed = False
     if acc is not None:
+        # short-deadline backend-init probe: the init line is the
+        # worker's FIRST emission (before any compile), so its absence
+        # pins the hang to backend init — fail fast with a diagnostic
+        # instead of burning the full worker deadline on a dead tunnel
         init = acc.wait(lambda r: r.get("stage") == "init",
-                        TPU_DEADLINE)
+                        min(INIT_DEADLINE, TPU_DEADLINE))
         if init is None:
+            backend_init_failed = True
             acc.kill("no init line — backend init hang")
-            print("# staged/default: backend never initialized within "
-                  f"{TPU_DEADLINE:.0f}s (hang pinned to backend init)",
-                  file=sys.stderr)
+            print("# staged/default: accelerator backend never "
+                  f"initialized within {INIT_DEADLINE:.0f}s — hang "
+                  "pinned to backend init (TPU tunnel down / PJRT "
+                  "plugin wedged); recording backend_init_failed and "
+                  "falling back to the CPU figure", file=sys.stderr)
             acc = None
         elif init["platform"] == "cpu":
             print("# staged/default: resolved to cpu (no accelerator "
@@ -556,6 +576,8 @@ def main():
         "cpu_rate": round(cpu_res["rate"], 1) if cpu_res else None,
         "cpu_engine": cpu_res.get("engine") if cpu_res else None,
     }
+    if backend_init_failed:
+        out["backend_init_failed"] = True
     if headline.get("map") == "map_flat12":
         # tiny-map figure: comparable in spirit, not in map scale —
         # flagged so the record can never overclaim

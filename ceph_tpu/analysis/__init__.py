@@ -1,10 +1,19 @@
-"""Concurrency-correctness layer: lockdep, stall watchdog.
+"""Correctness-analysis layer: lockdep, stall watchdog, JAX contracts.
 
 The src/common/lockdep.cc + sanitizer-wiring role for a framework
 that is dozens of threads deep (messenger readers + dispatch pool,
 quorum ticks, scheduler workers, recovery, heartbeats): concurrency
-structure is CHECKED at runtime, not assumed.  The static half lives
-in tools/lint_concurrency.py.
+structure is CHECKED at runtime, not assumed.  ``jaxcheck`` extends
+the same posture to the XLA axis — kernel shape/dtype contracts
+proven via ``jax.eval_shape`` under strict promotion, plus a
+recompilation budget gate over the booked per-shape compile counters.
+The static halves live in tools/lint_concurrency.py and
+tools/lint_jax.py.
+
+``jaxcheck`` is NOT imported here: importing it is free, but its
+verify path imports jax + the ec/crush kernels, and this package is
+loaded by every process (conftest pulls it before pinning the
+platform).  Import ``ceph_tpu.analysis.jaxcheck`` explicitly.
 """
 
 from .lockdep import (DLock, DRLock, enable, enabled, make_lock,

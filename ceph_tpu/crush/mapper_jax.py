@@ -214,11 +214,13 @@ class _RuleCompiler:
             keys = straw2_key(u, wts, rec, xp=jnp, ln_tab=self.ln16)
             keys = jnp.where(lane < sz, keys,
                              jnp.uint64(0xFFFFFFFFFFFFFFFF))
-            return A.items[bidx, jnp.argmin(keys)]
+            # argmin/argmax return the x64 index dtype (int64); the
+            # gather index lanes are int32 by contract (jaxcheck)
+            return A.items[bidx, jnp.argmin(keys).astype(I32)]
         draws = straw2_draw(u & jnp.uint32(0xFFFF), wts, xp=jnp,
                             tables=self.tabs)
         draws = jnp.where(lane < sz, draws, jnp.int64(C.S64_MIN))
-        return A.items[bidx, jnp.argmax(draws)]
+        return A.items[bidx, jnp.argmax(draws).astype(I32)]
 
     def _straw_choose(self, A, x, bidx, r):
         """Legacy straw (mapper.c:205-223)."""
@@ -228,7 +230,7 @@ class _RuleCompiler:
         draws = u.astype(jnp.uint64) * A.straws[bidx].astype(jnp.uint64)
         lane = jnp.arange(self.S, dtype=I32)
         draws = jnp.where(lane < sz, draws, jnp.uint64(0))
-        return A.items[bidx, jnp.argmax(draws)]
+        return A.items[bidx, jnp.argmax(draws).astype(I32)]
 
     def _list_choose(self, A, x, bidx, r):
         """Tail-to-head probabilistic descent (mapper.c:119-142): the C
@@ -660,6 +662,16 @@ def make_single_fn(cmap: CrushMap, ruleno: int, result_max: int,
                 rc.recip_aw = recip64(A.arg_weights, xp=jnp)
             else:
                 rc.recip_w = recip64(A.weights, xp=jnp)
+        try:
+            return _single_body(A, weight, x)
+        finally:
+            # the recips are TRACERS while jit traces this function;
+            # rc outlives the trace (the closure keeps it), so leaving
+            # them set leaks the dead tracer — jax.checking_leaks
+            # (the kernel-test gate) rejects the program
+            rc.recip_w = rc.recip_aw = None
+
+    def _single_body(A, weight, x):
         choose_tries = total_tries + 1  # mapper.c:906 off-by-one heritage
         choose_leaf_tries = 0
         local_retries = local_tries

@@ -315,6 +315,7 @@ class Messenger:
         self.throttles = throttles or {}
         self._handlers: Dict[str, Handler] = {}
         self._ordered: set = set()  # types on the serial lane
+        self._control: set = set()  # types on the control lane
         self._listener = socket.socket(socket.AF_INET,
                                        socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET,
@@ -346,14 +347,21 @@ class Messenger:
         self._conn_waiters: Dict[int, set] = {}
         self._pending_cv = threading.Condition(
             make_lock("msgr::pending"))
-        # lazy dispatch pool (DispatchQueue role); created on first
-        # inbound op so pure clients never spawn it
+        # lazy dispatch pools (DispatchQueue role); created on first
+        # inbound op so pure clients never spawn them.  Two lanes: the
+        # wide op pool, and a small CONTROL pool reserved for
+        # latency-critical types (heartbeats, map/peering pushes) so a
+        # burst of store ops occupying every op worker can never
+        # head-of-line-block failure detection — the reference's
+        # dedicated heartbeat messengers + mgr/mon priority queues.
         self._pool = None
+        self._ctl_pool = None
         self._pool_lock = make_lock("msgr::pool")
 
     # -- dispatch ------------------------------------------------------
     def register(self, type_: str, handler: Handler,
-                 ordered: bool = False) -> None:
+                 ordered: bool = False,
+                 control: bool = False) -> None:
         """Handler returns a reply dict (routed back by tid) or None.
 
         ``ordered=True`` puts the type on the per-session serial lane:
@@ -363,10 +371,19 @@ class Messenger:
         mon_accept(v+1) must not overtake mon_commit(v).  Unordered
         types keep full fast-dispatch parallelism (the reference's
         ms_fast_dispatch), so a store op blocking in the scheduler
-        can never head-of-line-block a session's control traffic."""
+        can never head-of-line-block a session's control traffic.
+
+        ``control=True`` additionally dispatches the type on the
+        dedicated control pool: a latency-critical frame (a heartbeat,
+        a map push, a peering probe) must never queue behind a burst
+        of shard writes that has every op worker blocked in the
+        object store.  Composes with ``ordered`` (the serial lane
+        drains on the control pool)."""
         self._handlers[type_] = handler
         if ordered:
             self._ordered.add(type_)
+        if control:
+            self._control.add(type_)
 
     def start(self) -> None:
         self._running = True
@@ -548,6 +565,7 @@ class Messenger:
         # abdication churn).  Everything else stays fully parallel;
         # per-object order there is owned by PG locks + versions, as
         # in the reference's sharded op queues.
+        control = type_ in self._control
         if ins is not None and type_ in self._ordered:
             with self._in_lock:
                 ins.fifo.append((conn, msg, seq, nbytes, t_rx))
@@ -555,10 +573,11 @@ class Messenger:
                 if drain:
                     ins.draining = True
             if drain:
-                self._pool_submit(self._drain_session, ins)
+                self._pool_submit(self._drain_session, ins,
+                                  control=control)
         else:
             self._pool_submit(self._handle, conn, msg, ins, seq,
-                              nbytes, t_rx)
+                              nbytes, t_rx, control=control)
 
     def _drain_session(self, ins: _InSession) -> None:
         """Serial lane worker: run one session's queued frames in
@@ -592,15 +611,24 @@ class Messenger:
                 return
             time.sleep(0.02)
 
-    def _pool_submit(self, fn, *args) -> None:
+    def _pool_submit(self, fn, *args, control: bool = False) -> None:
         with self._pool_lock:
-            pool = self._pool
-            if pool is None:
-                from concurrent.futures import ThreadPoolExecutor
+            if control:
+                pool = self._ctl_pool
+                if pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
 
-                pool = self._pool = ThreadPoolExecutor(
-                    max_workers=16,
-                    thread_name_prefix=f"msgr-dispatch:{self.name}")
+                    pool = self._ctl_pool = ThreadPoolExecutor(
+                        max_workers=4,
+                        thread_name_prefix=f"msgr-ctl:{self.name}")
+            else:
+                pool = self._pool
+                if pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    pool = self._pool = ThreadPoolExecutor(
+                        max_workers=16,
+                        thread_name_prefix=f"msgr-dispatch:{self.name}")
         try:
             pool.submit(fn, *args)
         except RuntimeError:
@@ -958,9 +986,11 @@ class Messenger:
         self._shut = True
         self._running = False
         with self._pool_lock:
-            pool, self._pool = self._pool, None
-        if pool is not None:
-            pool.shutdown(wait=False)
+            pools = (self._pool, self._ctl_pool)
+            self._pool = self._ctl_pool = None
+        for pool in pools:
+            if pool is not None:
+                pool.shutdown(wait=False)
         try:
             self._listener.close()
         except OSError:

@@ -75,8 +75,11 @@ class Client(MapFollower):
                               keyring=keyring, tracer=self.tracer,
                               perf=ctx.perf if ctx is not None
                               else None)
-        self.msgr.register("map_update", self._h_map_update)
-        self.msgr.register("map_inc", self._h_map_inc)
+        # map pushes on the control lane: a client retrying ops into a
+        # dead primary must still learn the new map promptly
+        self.msgr.register("map_update", self._h_map_update,
+                           control=True)
+        self.msgr.register("map_inc", self._h_map_inc, control=True)
         self.msgr.register("watch_notify", self._h_watch_notify)
         # (pool, oid) -> callback; re-registered with the (possibly
         # new) primary on every map change, like librados re-watch

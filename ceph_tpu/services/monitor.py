@@ -82,24 +82,34 @@ class Monitor:
 
         # write commands mutate the map: leader-only in quorum mode
         # (forwarded there); reads are served by any member
-        for t, h in (("boot", self._fwd(self._h_boot)),
-                     ("heartbeat", self._fwd(self._h_heartbeat,
-                                             fire_forget=True)),
-                     ("get_map", self._h_get_map),
-                     ("get_inc", self._h_get_inc),
-                     ("subscribe", self._h_subscribe),
-                     ("mark_down", self._fwd(self._h_mark_down)),
-                     ("mark_out", self._fwd(self._h_mark_out)),
-                     ("pool_create", self._fwd(self._h_pool_create)),
-                     ("pool_delete", self._fwd(self._h_pool_delete)),
-                     ("reweight", self._fwd(self._h_reweight)),
-                     ("pg_temp_set", self._fwd(self._h_pg_temp_set)),
-                     ("ec_profile_set",
-                      self._fwd(self._h_ec_profile_set)),
-                     ("pg_stats", self._h_pg_stats),
-                     ("health", self._h_health),
-                     ("status", self._h_status)):
-            self.msgr.register(t, h)
+        # heartbeats and map reads ride the messenger's control lane:
+        # failure detection must never queue behind a burst of client
+        # write commands holding every op-pool worker
+        for t, h, ctl in (("boot", self._fwd(self._h_boot), False),
+                          ("heartbeat", self._fwd(self._h_heartbeat,
+                                                  fire_forget=True),
+                           True),
+                          ("get_map", self._h_get_map, True),
+                          ("get_inc", self._h_get_inc, True),
+                          ("subscribe", self._h_subscribe, False),
+                          ("mark_down", self._fwd(self._h_mark_down),
+                           False),
+                          ("mark_out", self._fwd(self._h_mark_out),
+                           False),
+                          ("pool_create",
+                           self._fwd(self._h_pool_create), False),
+                          ("pool_delete",
+                           self._fwd(self._h_pool_delete), False),
+                          ("reweight", self._fwd(self._h_reweight),
+                           False),
+                          ("pg_temp_set",
+                           self._fwd(self._h_pg_temp_set), False),
+                          ("ec_profile_set",
+                           self._fwd(self._h_ec_profile_set), False),
+                          ("pg_stats", self._h_pg_stats, False),
+                          ("health", self._h_health, False),
+                          ("status", self._h_status, False)):
+            self.msgr.register(t, h, control=ctl)
         # PGMap role (src/mon/MgrStatMonitor / PGMap.cc): latest
         # primary-reported state per PG — observability state, NOT part
         # of the replicated epoch log (exactly as in the reference);

@@ -128,6 +128,12 @@ class OSDService(MapFollower):
                     "map_epochs"):
             self.pc.add_u64_counter(key)
 
+        # map pushes and peering probes ride the control lane: a burst
+        # of 16 queued shard writes holds every op-pool worker in the
+        # object store, and failure detection / remapping must not
+        # head-of-line-block behind it
+        control = {"map_update", "map_inc", "pg_info", "pg_poke",
+                   "pg_stray"}
         for t, h in (("shard_write", self._h_shard_write),
                      ("shard_read", self._h_shard_read),
                      ("pg_list", self._h_pg_list),
@@ -147,7 +153,7 @@ class OSDService(MapFollower):
                      ("map_update", self._h_map_update),
                      ("map_inc", self._h_map_inc),
                      ("status", self._h_status)):
-            self.msgr.register(t, h)
+            self.msgr.register(t, h, control=t in control)
 
     # -- persistence (superblock/restart-replay role) -------------------
     def _mount(self):

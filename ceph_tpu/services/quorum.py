@@ -103,14 +103,25 @@ class Quorum:
         # predecessor's mon_commit(v) on another dispatch worker is
         # nacked as non-contiguous, and a majority of such races makes
         # the leader spuriously abdicate (round-5 advisor medium #1)
+        # control=True as well: election and lease traffic IS failure
+        # detection — it must never wait for an op-pool slot behind a
+        # burst of client commands (the serial lane drains on the
+        # messenger's dedicated control pool)
         m = mon.msgr
-        m.register("mon_probe", self._h_probe, ordered=True)
-        m.register("mon_propose", self._h_propose, ordered=True)
-        m.register("mon_victory", self._h_victory, ordered=True)
-        m.register("mon_lease", self._h_lease, ordered=True)
-        m.register("mon_fetch", self._h_fetch, ordered=True)
-        m.register("mon_accept", self._h_accept, ordered=True)
-        m.register("mon_commit", self._h_commit, ordered=True)
+        m.register("mon_probe", self._h_probe, ordered=True,
+                   control=True)
+        m.register("mon_propose", self._h_propose, ordered=True,
+                   control=True)
+        m.register("mon_victory", self._h_victory, ordered=True,
+                   control=True)
+        m.register("mon_lease", self._h_lease, ordered=True,
+                   control=True)
+        m.register("mon_fetch", self._h_fetch, ordered=True,
+                   control=True)
+        m.register("mon_accept", self._h_accept, ordered=True,
+                   control=True)
+        m.register("mon_commit", self._h_commit, ordered=True,
+                   control=True)
 
         # restore the promise + staged entry a crash may have left
         # (Paxos.cc reads accepted_pn / uncommitted from the store).

@@ -36,6 +36,22 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache, shared with bench.py's .jax_cache:
+# the suite's wall time is dominated by compiling the big golden
+# mapper programs, and recompiling identical programs every run is
+# exactly the waste this PR's recompile gate exists to catch — warm
+# runs (the driver's verify pass after a populated run) save ~1-2
+# minutes.  Strictly an optimization: never a failure.
+try:
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        str(pathlib.Path(__file__).resolve().parent.parent
+            / ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+except Exception:  # cache unavailable on this jax build
+    pass
+
 # The axon TPU PJRT plugin is registered into EVERY python process by a
 # sitecustomize hook (gated on PALLAS_AXON_POOL_IPS), and a *registered*
 # plugin is initialized by backend discovery even under
@@ -54,8 +70,28 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 GOLDEN_DIR = pathlib.Path(__file__).resolve().parent / "golden"
 
-from ceph_tpu.analysis import lockdep, watchdog  # noqa: E402
+from ceph_tpu.analysis import jaxcheck, lockdep, watchdog  # noqa: E402
 from ceph_tpu.common import tracing  # noqa: E402
+
+# -- JAX hygiene gates (the XLA twin of the concurrency gates below) --
+#
+# Kernel test modules run under jax_numpy_dtype_promotion=strict: a
+# silent int64/float64 weak-type promotion in EC/CRUSH math becomes a
+# TypePromotionError at the test that introduces it (the contract
+# checker pins the fixed dtypes; this keeps new code honest at
+# runtime too).
+STRICT_DTYPE_MODULES = {
+    "test_ec", "test_jerasure", "test_lrc_isa", "test_shec",
+    "test_clay", "test_stripe", "test_native_gf", "test_pallas",
+    "test_mapper_jax", "test_mapper_spec", "test_contracts",
+}
+# jax.checking_leaks for the kernel suites that exercise every jitted
+# kernel cheaply: test_contracts traces them all (and this gate caught
+# a real leaked-tracer bug in the straw2 table-key path), test_pallas
+# covers the fused kernel.  NOT the wide EC roundtrip matrices — leak
+# checking disables trace caching and turned test_ec's 2s erasure
+# sweeps into 75s (measured), blowing the tier-1 time budget.
+TRACER_LEAK_MODULES = {"test_contracts", "test_pallas"}
 
 
 @pytest.fixture(scope="session", autouse=True)
@@ -64,6 +100,37 @@ def _stall_watchdog():
     messenger handler gets an all-thread stack dump on stderr while
     it hangs, instead of an opaque suite timeout."""
     yield watchdog.start_global(threshold=30.0)
+
+
+@pytest.fixture(autouse=True)
+def _jax_hygiene_gate(request):
+    """Per-test JAX gates, mirroring the concurrency gates below.
+
+    1. Strict dtype promotion + tracer-leak checking for the kernel
+       test modules (see the module sets above).
+    2. Recompile budget: any ``jaxcheck.steady_state()`` window that
+       booked a new XLA compile (the ec.engine / crush.mapper
+       per-shape-signature counters) fails THAT test — the
+       recompilation-storm class caught at the test introducing it.
+    """
+    import contextlib
+
+    mod = getattr(getattr(request, "module", None), "__name__", "")
+    mod = mod.rsplit(".", 1)[-1]
+    base = len(jaxcheck.recompile_violations())
+    with contextlib.ExitStack() as stack:
+        if mod in STRICT_DTYPE_MODULES:
+            stack.enter_context(jax.numpy_dtype_promotion("strict"))
+        if mod in TRACER_LEAK_MODULES:
+            stack.enter_context(jax.checking_leaks())
+        yield
+    vs = jaxcheck.recompile_violations()[base:]
+    if vs:
+        jaxcheck.clear_recompile_violations()  # don't re-fail later tests
+        detail = "\n".join(f"- [{v['label']}] {v['message']}"
+                           for v in vs)
+        pytest.fail(f"recompile gate: {len(vs)} steady-state "
+                    f"compile violation(s) during this test:\n{detail}")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,145 @@
+"""JAX kernel contracts (analysis/jaxcheck): every jitted EC/CRUSH
+kernel's shape/dtype contract proven via jax.eval_shape under strict
+dtype promotion, plus the recompilation budget gate.
+
+The parametrized test IS ``jaxcheck.verify_all()`` — one parameter per
+registered contract, each covering its plugin's k/m (and w/packetsize)
+grid including decode-with-erasures signatures.  A kernel change that
+drifts an output dtype (silent int64/float64 promotion, a float leak
+into the uint8 chunk lanes) or an output shape fails here without
+executing a single device op.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.analysis import jaxcheck
+
+# registration completeness: every EC plugin and both CRUSH lowerings
+# must carry a contract — deleting one (or forgetting to register a
+# new kernel's) fails here, not silently
+EXPECTED_CONTRACTS = {
+    "ec.engine.mod2_matmul", "ec.rs_jax", "ec.jerasure", "ec.isa",
+    "ec.lrc", "ec.shec", "ec.clay", "ec.native_gf", "ec.pallas",
+    "crush.mapper_jax", "crush.mapper_spec",
+}
+
+
+def test_every_kernel_has_a_contract():
+    assert set(jaxcheck.contracts()) == EXPECTED_CONTRACTS
+
+
+@pytest.mark.parametrize("name", sorted(EXPECTED_CONTRACTS))
+def test_contract_holds(name):
+    violations = jaxcheck.verify(name)
+    assert not violations, "\n".join(str(v) for v in violations)
+
+
+def test_checker_catches_dtype_drift():
+    """The checker must actually fire: a kernel whose output silently
+    promotes to int64 (and one whose shape is wrong) is flagged."""
+    import jax
+    import jax.numpy as jnp
+
+    def drifty(x):
+        # u8 + i64 → weak promotion the strict context forbids
+        return x.astype(jnp.int32) + jnp.int64(1)
+
+    def wrong_shape(x):
+        return jnp.zeros((x.shape[0] + 1,), jnp.uint8)
+
+    jaxcheck.register_contract("_test.bad", lambda: [
+        jaxcheck.Case("drift", drifty,
+                      [jax.ShapeDtypeStruct((8,), "uint8")],
+                      [((8,), "int32")]),
+        jaxcheck.Case("shape", wrong_shape,
+                      [jax.ShapeDtypeStruct((8,), "uint8")],
+                      [((8,), "uint8")]),
+    ])
+    try:
+        vs = jaxcheck.verify("_test.bad")
+        msgs = "\n".join(str(v) for v in vs)
+        assert len(vs) == 2, msgs
+        assert "strict" in vs[0].message or "drift" in vs[0].case
+        assert "mismatch" in vs[1].message
+    finally:
+        jaxcheck._REGISTRY.pop("_test.bad", None)
+
+
+def test_checker_catches_int64_lane_even_when_declared():
+    """Declaring an int64 output is not a loophole: integer lanes are
+    uint8/int32/uint32 by contract unless the case opts out."""
+    import jax
+    import jax.numpy as jnp
+
+    jaxcheck.register_contract("_test.lane", lambda: [
+        jaxcheck.Case("i64", lambda x: x.astype(jnp.int64),
+                      [jax.ShapeDtypeStruct((4,), "int32")],
+                      [((4,), "int64")]),
+    ])
+    try:
+        vs = jaxcheck.verify("_test.lane")
+        assert any("integer-lane drift" in v.message for v in vs)
+    finally:
+        jaxcheck._REGISTRY.pop("_test.lane", None)
+
+
+# ---------------------------------------------------------------------------
+# recompilation budget gate
+# ---------------------------------------------------------------------------
+
+def _fresh_rs():
+    """An RS instance with shapes unlikely to collide with any other
+    test's booked compile signatures (the counters are process-global)."""
+    from ceph_tpu.ec.rs_jax import RSCode
+
+    return RSCode(5, 2)
+
+
+def test_steady_state_clean_after_warmup():
+    code = _fresh_rs()
+    data = np.random.default_rng(7).integers(
+        0, 256, (5, 1184), dtype=np.uint8)
+    code.encode(data)  # warmup: trace + compile OUTSIDE the window
+    base = len(jaxcheck.recompile_violations())
+    with jaxcheck.steady_state("rs-steady"):
+        for _ in range(3):
+            code.encode(data)  # same shape signature: cache hits
+    assert jaxcheck.recompile_violations()[base:] == []
+
+
+def test_recompile_gate_catches_shape_instability():
+    """The acceptance case: a deliberately shape-unstable steady-state
+    phase (a new chunk length every call — the recompilation-storm
+    shape) must be caught by the gate."""
+    code = _fresh_rs()
+    base = len(jaxcheck.recompile_violations())
+    with jaxcheck.steady_state("rs-shape-unstable"):
+        for L in (1216, 1248, 1280):
+            code.encode(np.zeros((5, L), np.uint8))
+    caught = jaxcheck.recompile_violations()[base:]
+    # consume the violations: this test ASSERTS the gate fires; the
+    # per-test conftest gate must not then fail the test for it
+    jaxcheck.clear_recompile_violations()
+    assert caught, "shape-unstable phase was not caught"
+    assert "rs-shape-unstable" in caught[-1]["label"]
+    assert "ec.engine.jit_compiles" in caught[-1]["message"]
+
+
+def test_tracer_leak_gate_fires():
+    """The jax.checking_leaks gate (enabled module-wide by conftest
+    for the kernel suites): a jit that leaks its tracer through a
+    side channel raises instead of silently miscomputing later."""
+    import jax
+    import jax.numpy as jnp
+
+    leaked = []
+
+    @jax.jit
+    def leaky(x):
+        leaked.append(x)  # the tracer escapes the trace
+        return x * 2
+
+    with pytest.raises(Exception, match="[Ll]eak"):
+        with jax.checking_leaks():
+            leaky(jnp.arange(4))

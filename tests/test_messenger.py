@@ -299,3 +299,62 @@ def test_corrupt_frames_do_not_kill_the_server():
     finally:
         client.shutdown()
         server.shutdown()
+
+
+def test_control_lane_survives_op_burst():
+    """ADVICE round-5 low #3: latency-critical control frames
+    (heartbeats, map pushes, peering probes) get a dedicated dispatch
+    lane.  Saturate every op-pool worker (16) with slow shard writes,
+    then time a control-lane call: without the lane it waits for an
+    op worker (>= the shard-write service time); with it, it must
+    complete while every op worker is still blocked."""
+    server, client = mk_pair(lossless=False)
+    try:
+        release = threading.Event()
+        started = []
+        started_lock = threading.Lock()
+
+        def slow_write(msg):
+            with started_lock:
+                started.append(msg["n"])
+            release.wait(10)  # a shard write stuck in the store
+            return {"ok": True}
+
+        beats = []
+
+        def heartbeat(msg):
+            beats.append(time.monotonic())
+            return {"alive": True}
+
+        server.register("shard_write", slow_write)
+        server.register("heartbeat", heartbeat, control=True)
+
+        # saturate the op pool: 16 workers, 16 wedged writes
+        for n in range(16):
+            client.send(server.addr, {"type": "shard_write", "n": n})
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with started_lock:
+                if len(started) >= 16:
+                    break
+            time.sleep(0.01)
+        with started_lock:
+            assert len(started) >= 16, f"only {len(started)} writes " \
+                f"started — op pool not saturated, test is vacuous"
+
+        t0 = time.monotonic()
+        rep = client.call(server.addr, {"type": "heartbeat"},
+                          timeout=5)
+        dt = time.monotonic() - t0
+        assert rep.get("alive") is True
+        # every op worker is still wedged: the heartbeat can only have
+        # run on the control lane.  Generous bound — the regression
+        # mode is ~10s (waiting out a slow write), not ~2s.
+        assert dt < 2.0, f"heartbeat took {dt:.2f}s with the op pool " \
+            f"saturated — control lane is not isolating it"
+        assert beats, "heartbeat handler never ran"
+        release.set()
+    finally:
+        release.set()
+        client.shutdown()
+        server.shutdown()
