@@ -17,6 +17,8 @@ from __future__ import annotations
 import struct
 from typing import Dict, List, Tuple
 
+from .encoding import MalformedInput
+
 _U8 = struct.Struct("<B")
 _U16 = struct.Struct("<H")
 _U32 = struct.Struct("<I")
@@ -91,20 +93,25 @@ class Encoder:
         return b"".join(self._parts)
 
 
-class DecodeError(Exception):
-    pass
+class DecodeError(MalformedInput):
+    """Binary decode failure — a MalformedInput subtype, so transports
+    and mounts handle JSON-envelope and bincode corruption as one
+    typed protocol-error class."""
 
 
 class Decoder:
-    def __init__(self, buf: bytes, pos: int = 0):
+    def __init__(self, buf: bytes, pos: int = 0,
+                 struct_name: str = "structure"):
         self._b = buf
         self._pos = pos
         self._ends: List[int] = []
+        self.struct_name = struct_name
 
     def _take(self, n: int) -> bytes:
         if self._pos + n > len(self._b):
             raise DecodeError(
-                f"truncated: need {n} at {self._pos}/{len(self._b)}")
+                f"{self.struct_name}: truncated: need {n} at "
+                f"{self._pos}/{len(self._b)}")
         v = self._b[self._pos:self._pos + n]
         self._pos += n
         return v
@@ -128,7 +135,12 @@ class Decoder:
         return bytes(self._take(self.u32()))
 
     def str_(self) -> str:
-        return self.blob().decode("utf-8")
+        try:
+            return self.blob().decode("utf-8")
+        except UnicodeDecodeError as e:
+            # tampered bytes must surface as the typed protocol error,
+            # not an uncaught UnicodeDecodeError
+            raise DecodeError(f"{self.struct_name}: bad utf-8: {e}")
 
     def str_blob_map(self) -> Dict[str, bytes]:
         return {self.str_(): self.blob() for _ in range(self.u32())}
@@ -136,16 +148,24 @@ class Decoder:
     def str_list(self) -> List[str]:
         return [self.str_() for _ in range(self.u32())]
 
-    def start(self, max_supported_v: int) -> int:
+    def start(self, max_supported_v: int,
+              struct_name: str = None) -> int:
         """DECODE_START: returns struct_v; raises when the encoder's
         compat floor is newer than what this decoder supports."""
+        if struct_name is not None:
+            self.struct_name = struct_name
         struct_v = self.u8()
         compat_v = self.u8()
         length = self.u32()
         if compat_v > max_supported_v:
             raise DecodeError(
-                f"struct_v {struct_v} requires decoder >= {compat_v}, "
-                f"have {max_supported_v}")
+                f"{self.struct_name} (writer struct_v {struct_v}) "
+                f"requires decoder >= v{compat_v}, "
+                f"have v{max_supported_v}")
+        if self._pos + length > len(self._b):
+            raise DecodeError(
+                f"{self.struct_name}: envelope claims {length} bytes, "
+                f"only {len(self._b) - self._pos} remain")
         self._ends.append(self._pos + length)
         return struct_v
 
@@ -153,7 +173,8 @@ class Decoder:
         """DECODE_FINISH: skip fields this decoder didn't know about."""
         end = self._ends.pop()
         if self._pos > end:
-            raise DecodeError("decoded past envelope end")
+            raise DecodeError(
+                f"{self.struct_name}: decoded past envelope end")
         self._pos = end
 
     def remaining_in_envelope(self) -> int:
@@ -202,7 +223,7 @@ def encode_txn(ops: List[Tuple], enc: Encoder) -> None:
 
 
 def decode_txn(dec: Decoder) -> List[Tuple]:
-    dec.start(1)
+    dec.start(1, struct_name="os.txn")
     ops = []
     for _ in range(dec.u32()):
         fields = []

@@ -14,6 +14,12 @@ format is JSON; the envelope carries the same three facts:
 (the reference's buffer::malformed_input behavior) and delivers the
 payload with the writer's version so readers can branch on it — the
 ENCODE_START/DECODE_START contract, JSON-shaped.
+
+Every structure registered in ``analysis/wirecheck.py`` is
+machine-checked for the five conformance properties (round-trip,
+determinism, forward-compat, compat-floor refusal, mutation
+robustness) and pinned by the committed corpus under
+``tests/corpus/encodings/`` — the ceph-object-corpus role.
 """
 
 from __future__ import annotations
@@ -23,7 +29,11 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 
 class MalformedInput(ValueError):
-    pass
+    """A wire/disk blob this reader must refuse: truncated, tampered,
+    future-compat, or semantically undecodable.  The buffer::
+    malformed_input role — every decode seam raises THIS (never a raw
+    KeyError/struct.error/assert), so transports and mounts can treat
+    'bad bytes' as one clean protocol-error class."""
 
 
 def encode(data: Dict[str, Any], version: int = 1,
@@ -33,21 +43,45 @@ def encode(data: Dict[str, Any], version: int = 1,
     return json.dumps({"v": version, "compat": compat, "data": data})
 
 
-def decode(blob: str | bytes,
-           supported: int = 1) -> Tuple[int, Dict[str, Any]]:
+def decode(blob: str | bytes, supported: int = 1,
+           struct: str = "structure") -> Tuple[int, Dict[str, Any]]:
     """Returns (writer_version, payload); raises MalformedInput when
-    the writer demands a newer reader than ``supported``."""
+    the writer demands a newer reader than ``supported``.  ``struct``
+    names the structure in error messages — "which struct refused"
+    is the first question every decode failure raises."""
     try:
         env = json.loads(blob)
         v = int(env["v"])
         compat = int(env["compat"])
         data = env["data"]
     except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
-        raise MalformedInput(f"bad envelope: {e}")
+        raise MalformedInput(f"{struct}: bad envelope: {e}")
     if compat > supported:
         raise MalformedInput(
-            f"structure requires decoder v{compat}, have v{supported}")
+            f"{struct} (writer v{v}) requires decoder v{compat}, "
+            f"have v{supported}")
     return v, data
+
+
+def is_envelope(obj: Any) -> bool:
+    """True when a parsed JSON value has the envelope shape."""
+    return isinstance(obj, dict) and set(obj) == {"v", "compat", "data"}
+
+
+def decode_any(blob: str | bytes, supported: int = 1,
+               struct: str = "structure") -> Tuple[int, Any]:
+    """Lenient decode for formats MIGRATED behind the envelope: blobs
+    written before the migration are bare JSON values and decode as
+    writer version 0, so archived v0 data (an old image header, a
+    pre-envelope mon epoch file) keeps decoding forever — the
+    ceph-object-corpus backward-readability contract."""
+    try:
+        parsed = json.loads(blob)
+    except (TypeError, ValueError) as e:
+        raise MalformedInput(f"{struct}: undecodable blob: {e}")
+    if is_envelope(parsed):
+        return decode(blob, supported=supported, struct=struct)
+    return 0, parsed
 
 
 class Versioned:
@@ -56,6 +90,11 @@ class Versioned:
     Subclasses set STRUCT_V/COMPAT_V and may override
     ``upgrade(writer_v, data)`` to migrate old payloads forward — the
     role of the per-version branches inside reference decode() bodies.
+
+    A payload that survives the envelope but breaks from_dict (a
+    tampered field, a wrong type) is re-raised as MalformedInput
+    naming the struct and versions: decoding hostile bytes must be a
+    typed protocol error, never an uncaught KeyError.
     """
 
     STRUCT_V = 1
@@ -66,9 +105,18 @@ class Versioned:
 
     @classmethod
     def decode_versioned(cls, blob: str | bytes):
-        v, data = decode(blob, supported=cls.STRUCT_V)
-        data = cls.upgrade(v, data)
-        return cls.from_dict(data)
+        v, data = decode(blob, supported=cls.STRUCT_V,
+                         struct=cls.__name__)
+        try:
+            data = cls.upgrade(v, data)
+            return cls.from_dict(data)
+        except MalformedInput:
+            raise
+        except (KeyError, TypeError, ValueError, IndexError,
+                AttributeError) as e:
+            raise MalformedInput(
+                f"{cls.__name__} (writer v{v}, reader v"
+                f"{cls.STRUCT_V}): bad payload: {e!r}")
 
     @classmethod
     def upgrade(cls, writer_v: int, data: Dict[str, Any]

@@ -13,10 +13,10 @@ reference builder.
 
 from __future__ import annotations
 
-import json
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..common import encoding
 from . import constants as C
 
 
@@ -170,6 +170,13 @@ class ChooseArgMap(dict):
 class CrushMap:
     """The mutable host-side crush map."""
 
+    # wire/disk JSON form version (wirecheck entry crush.map_json):
+    # to_json wraps the dict in the versioned envelope; from_json also
+    # accepts the pre-envelope raw dict (writer v0 — the golden-vector
+    # era) so archived maps keep decoding
+    STRUCT_V = 1
+    COMPAT_V = 1
+
     def __init__(self, tunables: Optional[Tunables] = None):
         self.buckets: Dict[int, Bucket] = {}  # keyed by *bucket index* (-1-id)
         self.rules: Dict[int, Rule] = {}
@@ -278,8 +285,15 @@ class CrushMap:
         return m
 
     def to_json(self) -> str:
-        return json.dumps(self.to_dict())
+        return encoding.encode(self.to_dict(), self.STRUCT_V,
+                               self.COMPAT_V)
 
     @classmethod
     def from_json(cls, s: str) -> "CrushMap":
-        return cls.from_dict(json.loads(s))
+        v, d = encoding.decode_any(s, supported=cls.STRUCT_V,
+                                   struct="crush.map_json")
+        try:
+            return cls.from_dict(d)
+        except (KeyError, TypeError, ValueError, AttributeError) as e:
+            raise encoding.MalformedInput(
+                f"crush.map_json v{v}: bad payload: {e!r}")

@@ -58,6 +58,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 from ..analysis import watchdog
 from ..analysis.lockdep import make_lock, make_rlock
+from ..common.encoding import MalformedInput
 from ..common.log import getLogger
 from ..common.perf_counters import PerfCounters
 from ..common.tracing import Tracer
@@ -88,6 +89,15 @@ _ESC_KEY = "__frame_esc__"
 # segments in one frame, and a forged count must not allocate first
 _MAX_BLOBS = 1 << 16
 
+# decompression-bomb ceiling: a compressed control segment may expand
+# to at most this much.  The largest legitimate control segment is a
+# full-map JSON payload (a few MB at 10k OSDs — big maps travel as
+# binary map_bin data segments anyway); a 1 KiB frame claiming 100 MiB
+# of zeros is an attack on the receiver's memory, and the reference
+# bounds inbound message memory the same way
+# (osd_client_message_size_cap).  Module-level so tests can lower it.
+MAX_DECOMPRESSED = 32 << 20
+
 
 def _lift_blobs(obj, blobs: list):
     """Replace every bytes-like value with a data-segment reference —
@@ -113,13 +123,14 @@ def _restore_blobs(obj, blobs: list):
         if len(obj) == 1 and _BLOB_KEY in obj:
             idx = obj[_BLOB_KEY]
             if not isinstance(idx, int) or not 0 <= idx < len(blobs):
-                raise ValueError(f"blob index {idx!r} out of range "
-                                 f"(frame has {len(blobs)})")
+                raise MalformedInput(
+                    f"blob index {idx!r} out of range "
+                    f"(frame has {len(blobs)})")
             return blobs[idx]
         if len(obj) == 1 and _ESC_KEY in obj:
             inner = obj[_ESC_KEY]
             if not isinstance(inner, dict):
-                raise ValueError("malformed sentinel escape")
+                raise MalformedInput("malformed sentinel escape")
             return {k: _restore_blobs(v, blobs)
                     for k, v in inner.items()}
         return {k: _restore_blobs(v, blobs) for k, v in obj.items()}
@@ -128,15 +139,16 @@ def _restore_blobs(obj, blobs: list):
     return obj
 
 
-def _send_frame(sock: socket.socket, msg: Dict, keyring=None) -> int:
-    """Returns the wire size (header + payload) for the byte
-    counters."""
+def encode_frame(msg: Dict, keyring=None) -> bytes:
+    """The pure frame codec, encode half (the wirecheck-registered
+    seam): header + JSON control segment + blob table.  The outer
+    length word is the transport's, added at send time."""
     blobs: list = []
     jmsg = _lift_blobs(msg, blobs)
     if keyring is not None:
         jmsg.pop("mac", None)
         jmsg["mac"] = keyring.sign(jmsg, blobs)
-    body = json.dumps(jmsg).encode()
+    body = json.dumps(jmsg).encode()  # wire-ok: the frame codec seam
     flags = 0
     if len(body) > _COMPRESS_OVER:
         body = zlib.compress(body, 1)
@@ -146,7 +158,71 @@ def _send_frame(sock: socket.socket, msg: Dict, keyring=None) -> int:
     for b in blobs:
         parts.append(struct.pack("<I", len(b)))
         parts.append(b)
-    payload = b"".join(parts)
+    return b"".join(parts)
+
+
+def decode_frame(payload: bytes) -> Tuple[Dict, list]:
+    """The pure frame codec, decode half.  Returns (msg, blobs);
+    ``msg`` still holds data-segment references (the dispatcher
+    restores them after MAC verification).  Every length field is
+    bounds-checked against the frame, every parse failure raises
+    MalformedInput: a truncated, forged, or compression-bomb frame
+    must be a clean protocol error, never an uncaught struct.error
+    (or an unbounded allocation) that kills the reader thread with
+    its cleanup skipped."""
+    if len(payload) < 6:
+        raise MalformedInput(
+            f"frame too short ({len(payload)} bytes)")
+    ver, flags, jlen = struct.unpack_from("<BBI", payload, 0)
+    if ver != _FRAME_V:
+        # the frame-format compat floor: a peer speaking a newer
+        # framing must be refused, not misparsed
+        raise MalformedInput(f"unknown frame version {ver}, "
+                             f"have v{_FRAME_V}")
+    pos = 6
+    if pos + jlen + 4 > len(payload):
+        raise MalformedInput("truncated control segment")
+    body = payload[pos:pos + jlen]
+    pos += jlen
+    if flags & _FL_ZLIB:
+        d = zlib.decompressobj()
+        try:
+            body = d.decompress(body, MAX_DECOMPRESSED)
+        except zlib.error as e:
+            raise MalformedInput(f"bad compressed control: {e}")
+        if d.unconsumed_tail or not d.eof:
+            raise MalformedInput(
+                f"control segment decompresses past the "
+                f"{MAX_DECOMPRESSED}-byte cap")
+    (nblobs,) = struct.unpack_from("<I", payload, pos)
+    pos += 4
+    if nblobs > _MAX_BLOBS or nblobs * 4 > len(payload) - pos:
+        raise MalformedInput(f"blob table oversized ({nblobs} entries "
+                             f"in {len(payload) - pos} bytes)")
+    blobs = []
+    for _ in range(nblobs):
+        if pos + 4 > len(payload):
+            raise MalformedInput("truncated blob table")
+        (blen,) = struct.unpack_from("<I", payload, pos)
+        pos += 4
+        if pos + blen > len(payload):
+            raise MalformedInput("truncated blob")
+        blobs.append(payload[pos:pos + blen])
+        pos += blen
+    try:
+        msg = json.loads(body.decode())  # wire-ok: the frame codec seam
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise MalformedInput(f"undecodable control segment: {e}")
+    if not isinstance(msg, dict):
+        raise MalformedInput(
+            f"control segment is {type(msg).__name__}, not an object")
+    return msg, blobs
+
+
+def _send_frame(sock: socket.socket, msg: Dict, keyring=None) -> int:
+    """Returns the wire size (header + payload) for the byte
+    counters."""
+    payload = encode_frame(msg, keyring)
     with _send_locks_guard:
         lock = _send_locks.get(id(sock))
         if lock is None:
@@ -167,12 +243,8 @@ def _recv_exact(sock: socket.socket, n: int):
 
 
 def _recv_frame(sock: socket.socket):
-    """Returns (msg, blobs, nbytes) or None on EOF.  ``msg`` still
-    holds data-segment references; the dispatcher restores them after
-    MAC verification.  Every length field is bounds-checked against
-    the frame (raising ValueError): a truncated or forged blob table
-    must be a clean protocol error, never an uncaught struct.error
-    that kills the reader thread with its cleanup skipped."""
+    """Returns (msg, blobs, nbytes) or None on EOF; parse errors
+    surface as MalformedInput from the codec and drop the session."""
     header = _recv_exact(sock, 4)
     if header is None:
         return None
@@ -180,34 +252,8 @@ def _recv_frame(sock: socket.socket):
     payload = _recv_exact(sock, length)
     if payload is None:
         return None
-    if len(payload) < 6:
-        raise ValueError(f"frame too short ({len(payload)} bytes)")
-    ver, flags, jlen = struct.unpack_from("<BBI", payload, 0)
-    if ver != _FRAME_V:
-        raise ValueError(f"unknown frame version {ver}")
-    pos = 6
-    if pos + jlen + 4 > len(payload):
-        raise ValueError("truncated control segment")
-    body = payload[pos:pos + jlen]
-    pos += jlen
-    if flags & _FL_ZLIB:
-        body = zlib.decompress(body)
-    (nblobs,) = struct.unpack_from("<I", payload, pos)
-    pos += 4
-    if nblobs > _MAX_BLOBS or nblobs * 4 > len(payload) - pos:
-        raise ValueError(f"blob table oversized ({nblobs} entries in "
-                         f"{len(payload) - pos} bytes)")
-    blobs = []
-    for _ in range(nblobs):
-        if pos + 4 > len(payload):
-            raise ValueError("truncated blob table")
-        (blen,) = struct.unpack_from("<I", payload, pos)
-        pos += 4
-        if pos + blen > len(payload):
-            raise ValueError("truncated blob")
-        blobs.append(payload[pos:pos + blen])
-        pos += blen
-    return json.loads(body.decode()), blobs, length
+    msg, blobs = decode_frame(payload)
+    return msg, blobs, length
 
 
 class _OutSession:
@@ -781,7 +827,8 @@ class Messenger:
                                 f"{self.name}: no hello reply from "
                                 f"{addr}")
                 rep = self._pending.pop(tid)
-            if isinstance(rep, dict) and "__session_dead__" in rep:
+            if isinstance(rep, dict) and \
+                    "__session_dead__" in rep:  # wire-ok: local pending-table marker, never framed
                 raise OSError(f"{self.name}: {addr} "
                               f"{rep['__session_dead__']}")
             return rep
@@ -960,7 +1007,8 @@ class Messenger:
                                 f"{self.name}: no reply from {addr} "
                                 f"for {msg['type']}")
                 rep = self._pending.pop(tid)
-            if isinstance(rep, dict) and "__session_dead__" in rep:
+            if isinstance(rep, dict) and \
+                    "__session_dead__" in rep:  # wire-ok: local pending-table marker, never framed
                 # resync gave the peer up: fail now, not at timeout
                 raise OSError(f"{self.name}: {addr} "
                               f"{rep['__session_dead__']}")

@@ -17,6 +17,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from ..analysis.lockdep import make_rlock
+from ..common import encoding
 from .objectstore import (ObjectStore, Transaction, OP_CLONE, OP_MKCOLL,
                           OP_OMAP_CLEAR, OP_OMAP_RMKEYS,
                           OP_OMAP_SETKEYS, OP_REMOVE, OP_RMATTR,
@@ -225,6 +226,30 @@ class MemStore(ObjectStore):
                       for oid, o in objs.items()}
                 for cid, objs in self._coll.items()
             }
+
+    # the wire/disk form of a full-store export (wirecheck entry
+    # os.memstore_export): the raw hex-dict state, enveloped
+    EXPORT_V = 1
+
+    def export_blob(self) -> str:
+        # the collections live under their own key so a future writer
+        # can add sibling fields old readers skip (DECODE_FINISH)
+        return encoding.encode({"colls": self.export_state()},
+                               self.EXPORT_V, 1)
+
+    @classmethod
+    def import_blob(cls, blob) -> "MemStore":
+        """Lenient: pre-envelope raw-dict exports (writer v0 — the
+        bare collections dict) still decode — archived store dumps
+        stay importable."""
+        v, data = encoding.decode_any(blob, supported=cls.EXPORT_V,
+                                      struct="os.memstore_export")
+        try:
+            state = data if v < 1 else data["colls"]
+            return cls.import_state(state)
+        except (KeyError, TypeError, ValueError, AttributeError) as e:
+            raise encoding.MalformedInput(
+                f"os.memstore_export v{v}: bad payload: {e!r}")
 
     @classmethod
     def import_state(cls, state: Dict) -> "MemStore":

@@ -34,6 +34,8 @@ from typing import Dict, List, Optional, Tuple
 from ..analysis.lockdep import make_rlock
 from ..common.bincode import (DecodeError, Decoder, Encoder, decode_txn,
                               encode_txn)
+from ..common.encoding import MalformedInput
+from ..common.log import getLogger
 from .memstore import MemStore, _Object
 from .objectstore import ObjectStore, Transaction
 
@@ -41,6 +43,8 @@ _MAGIC = 0x57414C31   # "WAL1": raw body
 _MAGIC_Z = 0x57414C5A  # "WALZ": compressed body (compressor name
 #                        prefixed to the payload, length-prefixed)
 _HDR = struct.Struct("<IQII")
+
+CHECKPOINT_V = 1  # struct_v of the checkpoint's bincode envelope
 
 
 def _pack_body(body: bytes, comp) -> Tuple[int, bytes]:
@@ -55,13 +59,30 @@ def _pack_body(body: bytes, comp) -> Tuple[int, bytes]:
 
 
 def _unpack_body(magic: int, body: bytes) -> bytes:
+    """Raises MalformedInput for an unknown compressor tag or a body
+    that fails to decompress — a store written with a codec this build
+    lacks (or bit-rotted in the compressed region) must surface a
+    typed error the mount path can recover from, never a raw
+    KeyError/zlib.error crash."""
     if magic == _MAGIC:
         return body
     from ..common.compressor import Compressor
 
-    n = body[0]
-    name = body[1:1 + n].decode()
-    return Compressor(name).decompress(body[1 + n:])
+    try:
+        n = body[0]
+        name = body[1:1 + n].decode()
+    except (IndexError, UnicodeDecodeError) as e:
+        raise MalformedInput(f"os.wal_checkpoint: bad compressor "
+                             f"tag: {e}")
+    try:
+        codec = Compressor(name)
+    except KeyError as e:
+        raise MalformedInput(f"os.wal_checkpoint: {e.args[0]}")
+    try:
+        return codec.decompress(body[1 + n:])
+    except Exception as e:
+        raise MalformedInput(f"os.wal_checkpoint: body fails "
+                             f"{name} decompression: {e!r}")
 
 
 def _crc32c(data: bytes) -> int:
@@ -70,12 +91,110 @@ def _crc32c(data: bytes) -> int:
     return int(_c(data))
 
 
+# -- pure record/checkpoint codecs (the wirecheck-registered seam) ----
+
+def encode_record(seq: int, ops: List[Tuple]) -> bytes:
+    """One WAL record: header (magic, seq, len, crc32c) + bincode txn
+    payload.  Records are never compressed — their latency is the
+    write ack path."""
+    enc = Encoder()
+    encode_txn(ops, enc)
+    payload = enc.bytes()
+    return _HDR.pack(_MAGIC, seq, len(payload),
+                     _crc32c(payload)) + payload
+
+
+def decode_record(buf: bytes, pos: int = 0) -> Tuple[int, bytes, int]:
+    """Parse one record at ``pos``; returns (seq, payload, end).
+    Every torn/forged shape — short header, bad magic, truncated
+    payload, crc mismatch — raises MalformedInput, which replay
+    interprets as the un-acked tail."""
+    if pos + _HDR.size > len(buf):
+        raise MalformedInput("os.wal_record: truncated header")
+    magic, seq, ln, crc = _HDR.unpack_from(buf, pos)
+    if magic != _MAGIC:
+        raise MalformedInput(f"os.wal_record: bad magic {magic:#x}")
+    end = pos + _HDR.size + ln
+    if end > len(buf):
+        raise MalformedInput("os.wal_record: truncated payload")
+    payload = buf[pos + _HDR.size:end]
+    if _crc32c(payload) != crc:
+        raise MalformedInput("os.wal_record: crc mismatch")
+    return seq, payload, end
+
+
+def encode_checkpoint(seq: int,
+                      colls: Dict[str, Dict[str, _Object]],
+                      comp=None) -> bytes:
+    """The full checkpoint file image: header + (optionally
+    compressed) bincode-enveloped store snapshot."""
+    enc = Encoder()
+    enc.start(CHECKPOINT_V, 1)
+    enc.u64(seq)
+    enc.u32(len(colls))
+    for cid in sorted(colls):
+        enc.str_(cid)
+        objs = colls[cid]
+        enc.u32(len(objs))
+        for oid in sorted(objs):
+            o = objs[oid]
+            enc.str_(oid)
+            enc.blob(bytes(o.data))
+            enc.str_blob_map(o.xattr)
+            enc.str_blob_map(o.omap)
+    enc.finish()
+    magic, body = _pack_body(enc.bytes(), comp)
+    return _HDR.pack(magic, seq, len(body), _crc32c(body)) + body
+
+
+def decode_checkpoint(raw: bytes
+                      ) -> Tuple[int, Dict[str, Dict[str, _Object]]]:
+    """Returns (seq, collections).  All corruption classes — short
+    file, bad magic, length/crc mismatch, unknown compressor,
+    truncated compressed body, envelope damage — raise MalformedInput
+    so mount() can fall back to WAL replay instead of crashing."""
+    if len(raw) < _HDR.size:
+        raise MalformedInput("os.wal_checkpoint: truncated header")
+    magic, seq, ln, crc = _HDR.unpack_from(raw)
+    body = raw[_HDR.size:_HDR.size + ln]
+    if magic not in (_MAGIC, _MAGIC_Z) or len(body) != ln \
+            or _crc32c(body) != crc:
+        raise MalformedInput(
+            "os.wal_checkpoint: bad magic/length/crc")
+    dec = Decoder(_unpack_body(magic, body),
+                  struct_name="os.wal_checkpoint")
+    dec.start(CHECKPOINT_V)
+    got_seq = dec.u64()
+    if got_seq != seq:
+        raise MalformedInput(
+            f"os.wal_checkpoint: header seq {seq} != body seq "
+            f"{got_seq}")
+    colls: Dict[str, Dict[str, _Object]] = {}
+    for _ in range(dec.u32()):
+        cid = dec.str_()
+        objs: Dict[str, _Object] = {}
+        for _ in range(dec.u32()):
+            oid = dec.str_()
+            o = _Object()
+            o.data = bytearray(dec.blob())
+            o.xattr = dec.str_blob_map()
+            o.omap = dec.str_blob_map()
+            objs[oid] = o
+        colls[cid] = objs
+    dec.finish()
+    return seq, colls
+
+
 class WALStore(ObjectStore):
     def __init__(self, path: str, checkpoint_every_bytes: int = 1 << 24,
                  sync: bool = True, compression: str = "zlib"):
         from ..common.compressor import Compressor
 
         self.path = path
+        self.log = getLogger("wal")
+        # set when mount() found a checkpoint it could not decode and
+        # fell back to WAL-only recovery — surfaced, not swallowed
+        self.last_mount_error: Optional[str] = None
         # checkpoints compress through the registry (WAL records stay
         # raw: their latency is the write ack path); mount reads both
         # formats, so the option can change between runs
@@ -133,18 +252,14 @@ class WALStore(ObjectStore):
             # 1. encode (an unencodable txn never journals) and
             #    validate + stage in memory (atomic: all ops or none;
             #    nothing visible yet)
-            enc = Encoder()
-            encode_txn(txn.ops, enc)
-            payload = enc.bytes()
+            seq = self._seq + 1
+            rec = encode_record(seq, txn.ops)
             commit = self._mem.prepare_transaction(txn)
             # 2. journal; the fsync below is the ack point.  Journal
             #    BEFORE the visible swap: if the append fails (ENOSPC,
             #    EIO) the store state still equals the journal, and if
             #    we crash right after the fsync the replay applies the
             #    exact staged ops.
-            seq = self._seq + 1
-            rec = _HDR.pack(_MAGIC, seq, len(payload),
-                            _crc32c(payload)) + payload
             try:
                 self._wal_f.write(rec)
                 self._wal_f.flush()
@@ -199,27 +314,10 @@ class WALStore(ObjectStore):
 
     def _write_checkpoint(self, seq: int) -> None:
         os.makedirs(self.path, exist_ok=True)
-        enc = Encoder()
-        enc.start(1, 1)
-        enc.u64(seq)
-        colls = self._mem._coll
-        enc.u32(len(colls))
-        for cid in sorted(colls):
-            enc.str_(cid)
-            objs = colls[cid]
-            enc.u32(len(objs))
-            for oid in sorted(objs):
-                o = objs[oid]
-                enc.str_(oid)
-                enc.blob(bytes(o.data))
-                enc.str_blob_map(o.xattr)
-                enc.str_blob_map(o.omap)
-        enc.finish()
-        magic, body = _pack_body(enc.bytes(), self._comp)
+        blob = encode_checkpoint(seq, self._mem._coll, self._comp)
         tmp = self._ckpt_path + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(_HDR.pack(magic, seq, len(body), _crc32c(body)))
-            f.write(body)
+            f.write(blob)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._ckpt_path)  # atomic on POSIX
@@ -233,34 +331,26 @@ class WALStore(ObjectStore):
     def _load_checkpoint(self) -> None:
         self._mem = MemStore()
         self._seq = self._ckpt_seq = 0
+        self.last_mount_error = None
         try:
             raw = open(self._ckpt_path, "rb").read()
         except FileNotFoundError:
             return
-        if len(raw) < _HDR.size:
-            return  # mkfs crashed mid-write; empty store
-        magic, seq, ln, crc = _HDR.unpack_from(raw)
-        body = raw[_HDR.size:_HDR.size + ln]
-        if magic not in (_MAGIC, _MAGIC_Z) or len(body) != ln \
-                or _crc32c(body) != crc:
-            raise RuntimeError(f"corrupt checkpoint at {self._ckpt_path}")
-        dec = Decoder(_unpack_body(magic, body))
-        dec.start(1)
-        got_seq = dec.u64()
-        assert got_seq == seq
-        colls: Dict[str, Dict[str, _Object]] = {}
-        for _ in range(dec.u32()):
-            cid = dec.str_()
-            objs: Dict[str, _Object] = {}
-            for _ in range(dec.u32()):
-                oid = dec.str_()
-                o = _Object()
-                o.data = bytearray(dec.blob())
-                o.xattr = dec.str_blob_map()
-                o.omap = dec.str_blob_map()
-                objs[oid] = o
-            colls[cid] = objs
-        dec.finish()
+        try:
+            seq, colls = decode_checkpoint(raw)
+        except MalformedInput as e:
+            # an undecodable checkpoint (unknown compressor tag,
+            # truncated compressed body, bit rot) must not brick the
+            # store: surface the error and recover from the WAL alone
+            # (ckpt_seq stays 0, so every journaled record replays).
+            # Anything folded into the bad checkpoint and already
+            # truncated out of the WAL is gone either way — mounting
+            # what the journal proves beats refusing to mount.
+            self.last_mount_error = (
+                f"checkpoint at {self._ckpt_path} undecodable "
+                f"({e}); recovering from WAL only")
+            self.log.derr(f"wal: {self.last_mount_error}")
+            return
         self._mem._coll = colls
         self._seq = self._ckpt_seq = seq
 
@@ -274,24 +364,35 @@ class WALStore(ObjectStore):
         except FileNotFoundError:
             return 0
         pos = 0
-        while pos + _HDR.size <= len(raw):
-            magic, seq, ln, crc = _HDR.unpack_from(raw, pos)
-            if magic != _MAGIC or pos + _HDR.size + ln > len(raw):
+        while pos < len(raw):
+            try:
+                seq, payload, end = decode_record(raw, pos)
+            except MalformedInput:
                 break  # torn tail
-            payload = raw[pos + _HDR.size:pos + _HDR.size + ln]
-            if _crc32c(payload) != crc:
-                break  # torn/corrupt tail
             if seq <= self._ckpt_seq:
-                pos += _HDR.size + ln
+                pos = end
                 continue  # folded into the checkpoint already
             try:
                 ops = decode_txn(Decoder(payload))
             except DecodeError:
                 break
-            pos += _HDR.size + ln
             txn = Transaction()
             txn.ops = ops
-            self._mem.queue_transaction(txn)
+            try:
+                self._mem.queue_transaction(txn)
+            except Exception as e:
+                # a record whose base state is gone (checkpoint lost
+                # to bit rot, so this txn's preconditions vanished):
+                # stop replay at the last applicable prefix and SAY
+                # so — the prefix contract holds, the loss is
+                # surfaced, and the store still mounts
+                self.last_mount_error = (
+                    (self.last_mount_error or "") +
+                    f"; WAL record seq {seq} no longer applies "
+                    f"({e!r}) — replay stopped there").lstrip("; ")
+                self.log.derr(f"wal: {self.last_mount_error}")
+                break
+            pos = end
             self._seq = seq
         return pos
 

@@ -20,7 +20,7 @@ from typing import List
 
 import numpy as np
 
-from ..common.bincode import Decoder, Encoder
+from ..common.bincode import DecodeError, Decoder, Encoder
 from ..crush.map import (Bucket, ChooseArg, ChooseArgMap, CrushMap,
                          Rule, RuleStep, Tunables)
 from .osdmap import OSDMap, PgPool
@@ -31,7 +31,13 @@ def _arr(enc: Encoder, xs, dtype="<i4") -> None:
 
 
 def _unarr(dec: Decoder, dtype="<i4") -> List[int]:
-    return np.frombuffer(dec.blob(), dtype).tolist()
+    blob = dec.blob()
+    try:
+        return np.frombuffer(blob, dtype).tolist()
+    except ValueError as e:
+        # a tampered length word leaves a ragged array blob; that is
+        # a protocol error, not a numpy usage error
+        raise DecodeError(f"{dec.struct_name}: bad array blob: {e}")
 
 
 # -- crush ------------------------------------------------------------------
@@ -83,7 +89,7 @@ def encode_crush(m: CrushMap, enc: Encoder) -> None:
 
 
 def decode_crush(dec: Decoder) -> CrushMap:
-    dec.start(1)
+    dec.start(1, struct_name="osdmap.crush")
     tun = Tunables(*(dec.u32() for _ in range(6)))
     m = CrushMap(tunables=tun)
     max_devices = dec.u32()
@@ -163,7 +169,7 @@ def encode_osdmap(m: OSDMap, enc: Encoder) -> None:
 
 
 def decode_osdmap(dec: Decoder) -> OSDMap:
-    dec.start(1)
+    dec.start(1, struct_name="osdmap.full")
     epoch, max_osd = dec.u32(), dec.u32()
     osd_state = _unarr(dec, "<u4")
     osd_weight = _unarr(dec, "<u4")
@@ -208,6 +214,20 @@ def decode_osdmap(dec: Decoder) -> OSDMap:
     return m
 
 
+def _typed(fn, buf: bytes, struct_name: str):
+    """Decode with every failure surfaced as MalformedInput: bytes
+    that survive the envelope but build an impossible map (a dup
+    bucket id from a flipped byte, a ragged rule program) are still
+    protocol errors, never raw ValueError/struct.error escapes."""
+    try:
+        return fn(Decoder(buf, struct_name=struct_name))
+    except DecodeError:
+        raise
+    except (ValueError, TypeError, KeyError, IndexError,
+            OverflowError) as e:
+        raise DecodeError(f"{struct_name}: bad payload: {e!r}")
+
+
 def osdmap_to_bytes(m: OSDMap) -> bytes:
     enc = Encoder()
     encode_osdmap(m, enc)
@@ -215,7 +235,7 @@ def osdmap_to_bytes(m: OSDMap) -> bytes:
 
 
 def osdmap_from_bytes(buf: bytes) -> OSDMap:
-    return decode_osdmap(Decoder(buf))
+    return _typed(decode_osdmap, buf, "osdmap.full")
 
 
 def crush_to_bytes(m: CrushMap) -> bytes:
@@ -225,7 +245,7 @@ def crush_to_bytes(m: CrushMap) -> bytes:
 
 
 def crush_from_bytes(buf: bytes) -> CrushMap:
-    return decode_crush(Decoder(buf))
+    return _typed(decode_crush, buf, "osdmap.crush")
 
 
 def payload_map(payload: dict) -> OSDMap:

@@ -60,6 +60,20 @@ class Incremental(Versioned):
     new_primary_temp: Dict[PgId, int] = field(default_factory=dict)
     new_crush: Optional[dict] = None  # full crush swap (rare)
 
+    @classmethod
+    def upgrade(cls, writer_v: int, data: dict) -> dict:
+        """Migrate archived v1 deltas (pre pg_upmap/primary_temp/
+        pool-deletion) forward: the v2-added tables default to empty.
+        A v1 WRITER could not have populated them, so an explicit
+        empty is exactly its intent — the per-version decode branch
+        of the reference's Incremental::decode."""
+        if writer_v < 2:
+            data = dict(data)
+            for key in ("new_pg_upmap", "old_pg_upmap",
+                        "new_primary_temp", "old_pools"):
+                data.setdefault(key, [])
+        return data
+
     def empty(self) -> bool:
         return not (self.new_max_osd is not None or self.new_pools
                     or self.old_pools

@@ -19,10 +19,11 @@ tested against this one.
 
 from __future__ import annotations
 
-import json
+import dataclasses
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ..common.encoding import Versioned
 from ..crush.constants import CRUSH_ITEM_NONE
 from ..crush.hash import hash32_2_int
 from ..crush.map import CrushMap
@@ -53,8 +54,11 @@ def _calc_mask(n: int) -> int:
 
 
 @dataclass
-class PgPool:
+class PgPool(Versioned):
     """pg_pool_t essentials (src/osd/osd_types.h:1300-1850)."""
+
+    STRUCT_V = 1
+    COMPAT_V = 1
 
     pool_type: int = POOL_TYPE_REPLICATED
     size: int = 3
@@ -103,11 +107,22 @@ class PgPool:
 
     @classmethod
     def from_dict(cls, d):
-        return cls(**d)
+        # skip fields a NEWER writer added (the DECODE_FINISH
+        # contract): an old reader must decode the fields it knows
+        # and ignore the rest, not crash on an unexpected kwarg
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
 
 
 class OSDMap:
     """The mutable host cluster map (src/osd/OSDMap.h)."""
+
+    # JSON tool/debug form version: to_json wraps the dict in the
+    # versioned envelope; from_json also accepts the pre-envelope raw
+    # dict (writer v0).  The WIRE form is the bincode encode
+    # (osdmap/bincode_maps.py, wirecheck entry osdmap.full).
+    STRUCT_V = 1
+    COMPAT_V = 1
 
     def __init__(self, crush: Optional[CrushMap] = None):
         self.epoch = 1
@@ -330,8 +345,19 @@ class OSDMap:
         return m
 
     def to_json(self) -> str:
-        return json.dumps(self.to_dict())
+        from ..common import encoding
+
+        return encoding.encode(self.to_dict(), self.STRUCT_V,
+                               self.COMPAT_V)
 
     @classmethod
     def from_json(cls, s: str) -> "OSDMap":
-        return cls.from_dict(json.loads(s))
+        from ..common import encoding
+
+        v, d = encoding.decode_any(s, supported=cls.STRUCT_V,
+                                   struct="osdmap.json")
+        try:
+            return cls.from_dict(d)
+        except (KeyError, TypeError, ValueError, AttributeError) as e:
+            raise encoding.MalformedInput(
+                f"osdmap.json v{v}: bad payload: {e!r}")

@@ -18,11 +18,29 @@ are preserved; the storage cost differs.
 
 from __future__ import annotations
 
-import json
 from typing import Dict, List, Optional
 
+from ..common import encoding
 from .client import Client, ObjectNotFound
 from .striper import Striper, _piece_name
+
+# wire/disk version of the header object (wirecheck entry
+# rbd.image_header).  Writer v0 = the pre-envelope raw-dict era;
+# decode stays lenient so existing images keep opening.
+HEADER_V = 1
+
+
+def encode_header(header: Dict) -> bytes:
+    return encoding.encode(dict(header), HEADER_V, 1).encode()
+
+
+def decode_header(raw: bytes) -> Dict:
+    v, d = encoding.decode_any(raw, supported=HEADER_V,
+                               struct="rbd.image_header")
+    if not isinstance(d, dict):
+        raise encoding.MalformedInput(
+            f"rbd.image_header v{v}: payload is not an object")
+    return d
 
 
 def _header_oid(name: str) -> str:
@@ -64,8 +82,7 @@ class Image:
                   "stripe_count": stripe_count,
                   "object_size": object_size, "snaps": [],
                   "parent": None, "children": []}
-        client.put(pool_id, _header_oid(name),
-                   json.dumps(header).encode())
+        client.put(pool_id, _header_oid(name), encode_header(header))
         return cls(client, pool_id, name, header)
 
     @classmethod
@@ -74,18 +91,18 @@ class Image:
             raw = client.get(pool_id, _header_oid(name))
         except ObjectNotFound:
             raise ImageError(f"no image {name!r}")
-        return cls(client, pool_id, name, json.loads(raw.decode()))
+        return cls(client, pool_id, name, decode_header(raw))
 
     def _save_header(self) -> None:
         self.client.put(self.pool_id, _header_oid(self.name),
-                        json.dumps(self._h).encode())
+                        encode_header(self._h))
 
     def _reload_header(self) -> None:
         """The header lives in RADOS; another handle (a clone's
         flatten, a second opener) may have changed it — snapshot/clone
         bookkeeping re-reads before deciding."""
         raw = self.client.get(self.pool_id, _header_oid(self.name))
-        self._h = json.loads(raw.decode())
+        self._h = decode_header(raw)
 
     # -- geometry -------------------------------------------------------
     @property

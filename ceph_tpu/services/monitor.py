@@ -28,11 +28,32 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..analysis.lockdep import make_lock, make_rlock
+from ..common import encoding
 from ..common.context import Context
 from ..common.op_tracker import OpTracker
 from ..msg.messenger import Addr, Messenger
 from ..osdmap.osdmap import OSDMap, PgPool
 from .quorum import Quorum
+
+# the epoch-store payload format (MonitorDBStore full-map rows,
+# wirecheck entry mon.epoch_payload): one envelope around
+# {epoch, map, osd_addrs, ec_profiles}.  Files written before the
+# migration are raw dicts (writer v0) and keep decoding, so a monitor
+# resumes from an old store_dir unchanged.
+EPOCH_PAYLOAD_V = 1
+
+
+def encode_epoch_payload(payload: Dict) -> str:
+    return encoding.encode(payload, EPOCH_PAYLOAD_V, 1)
+
+
+def decode_epoch_payload(blob) -> Dict:
+    v, d = encoding.decode_any(blob, supported=EPOCH_PAYLOAD_V,
+                               struct="mon.epoch_payload")
+    if not isinstance(d, dict):
+        raise encoding.MalformedInput(
+            f"mon.epoch_payload v{v}: payload is not an object")
+    return d
 
 
 class Monitor:
@@ -173,7 +194,7 @@ class Monitor:
     def apply_committed(self, v: int, entry: Dict) -> None:
         """Install a majority-committed epoch (peon apply / leader
         sync): replace live state from the full payload, store, push."""
-        p = json.loads(entry["payload"])
+        p = decode_epoch_payload(entry["payload"])
         with self._lock:
             if v != self._committed_epoch + 1:
                 # duplicate/stale delivery (racing catch-up paths must
@@ -253,7 +274,7 @@ class Monitor:
                 except OSError:
                     continue
             newest = max(self._epochs)
-            p = json.loads(self._epochs[newest])
+            p = decode_epoch_payload(self._epochs[newest])
             self.map = OSDMap.from_dict(p["map"])
             self._osd_addrs = {int(k): tuple(a)
                                for k, a in p["osd_addrs"].items()}
@@ -286,7 +307,7 @@ class Monitor:
             with self._lock:
                 self.map.epoch += 1
                 v = self.map.epoch
-                payload = json.dumps(self._map_payload())
+                payload = encode_epoch_payload(self._map_payload())
                 inc_d = None
                 if self._prev_map is not None:
                     inc = diff_maps(self._prev_map, self.map)
@@ -315,7 +336,7 @@ class Monitor:
             if inc_d is not None:
                 self._incs[v] = inc_d
             self._prev_map = OSDMap.from_dict(
-                json.loads(payload)["map"])
+                decode_epoch_payload(payload)["map"])
             self._committed_epoch = v
             keep = self.ctx.conf["mon_max_map_epochs"]
             for e in sorted(self._epochs)[:-keep]:
@@ -369,7 +390,7 @@ class Monitor:
             if self._committed_epoch == 0:
                 self.map.epoch = 0
                 return
-            p = json.loads(self._epochs[self._committed_epoch])
+            p = decode_epoch_payload(self._epochs[self._committed_epoch])
             self.map = OSDMap.from_dict(p["map"])
             self._osd_addrs = {int(k): tuple(a)
                                for k, a in p["osd_addrs"].items()}
@@ -385,7 +406,7 @@ class Monitor:
     def get_epoch_payload(self, epoch: int) -> Optional[Dict]:
         with self._lock:
             raw = self._epochs.get(epoch)
-        return json.loads(raw) if raw else None
+        return decode_epoch_payload(raw) if raw else None
 
     def _wire_full(self, payload: Dict) -> Dict:
         """Full-map payload for the WIRE: the map travels as its
@@ -420,7 +441,7 @@ class Monitor:
                 return
             inc = self._incs.get(epoch)
             payload = None if inc is not None else \
-                json.loads(self._epochs[epoch])
+                decode_epoch_payload(self._epochs[epoch])
             extras = {"osd_addrs": {str(k): list(v) for k, v in
                                     self._osd_addrs.items()},
                       "ec_profiles": dict(self.ec_profiles)}
@@ -486,7 +507,7 @@ class Monitor:
         with self._lock:
             if self._committed_epoch == 0:
                 return {"error": "no committed map yet"}
-            payload = json.loads(self._epochs[self._committed_epoch])
+            payload = decode_epoch_payload(self._epochs[self._committed_epoch])
         return self._wire_full(payload)
 
     def _h_subscribe(self, msg: Dict) -> Dict:
@@ -502,7 +523,7 @@ class Monitor:
             if self._committed_epoch == 0:
                 reply = {"error": "no committed map yet"}
             else:
-                reply = json.loads(self._epochs[self._committed_epoch])
+                reply = decode_epoch_payload(self._epochs[self._committed_epoch])
         if stale is not None:
             stale.stop()
         return self._wire_full(reply) if "map" in reply else reply

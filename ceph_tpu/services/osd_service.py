@@ -46,8 +46,10 @@ from ..os.objectstore import Transaction
 from ..osdmap.osdmap import OSDMap, POOL_TYPE_ERASURE
 
 
+from ..common.encoding import MalformedInput
 from ..common.op_queue import Requeue
 from ..common.version import NULL_VERSION, bump, make_version
+from .pg_log import PgLogEntry
 
 
 def pg_cid(pool_id: int, ps: int) -> str:
@@ -262,8 +264,6 @@ class OSDService(MapFollower):
                                  lambda: self._do_shard_write(msg))
 
     def _do_shard_write(self, msg: Dict) -> Dict:
-        import json as _json
-
         from ..ec.stripe import crc32c
 
         cid = pg_cid(msg["pool"], msg["ps"])
@@ -319,10 +319,10 @@ class OSDService(MapFollower):
                     if drop:
                         txn.omap_rmkeys(cid, "pglog", drop)
                 txn.omap_setkeys(cid, "pglog", {
-                    f"{v}|{msg['shard']}": _json.dumps(
-                        {"op": "write", "oid": msg["oid"],
-                         "shard": msg["shard"], "v": v,
-                         "size": msg["size"]}).encode()})
+                    f"{v}|{msg['shard']}": PgLogEntry(
+                        op="write", oid=msg["oid"],
+                        shard=msg["shard"], v=v,
+                        size=msg["size"]).encode_blob()})
                 op.mark_event("queued_for_store")
                 self.store.queue_transaction(txn)
             op.mark_event("commit")
@@ -351,8 +351,6 @@ class OSDService(MapFollower):
     def _h_obj_delete(self, msg: Dict) -> Dict:
         """Remove every local shard of an object and tombstone the
         log, so the delete wins over older writes at peering time."""
-        import json as _json
-
         cid = pg_cid(msg["pool"], msg["ps"])
         v = msg.get("v") or make_version(self.epoch)
         if msg.get("restamp"):
@@ -404,9 +402,8 @@ class OSDService(MapFollower):
                     if drop:
                         txn.omap_rmkeys(cid, "pglog", drop)
             txn.omap_setkeys(cid, "pglog", {
-                f"{v}|d": _json.dumps(
-                    {"op": "delete", "oid": msg["oid"],
-                     "v": v}).encode()})
+                f"{v}|d": PgLogEntry(op="delete", oid=msg["oid"],
+                                     v=v).encode_blob()})
             self.store.queue_transaction(txn)
         return {"ok": True, "epoch": self.epoch}
 
@@ -732,8 +729,6 @@ class OSDService(MapFollower):
         position map is what makes peering correct across remaps: an
         EC member that moved from position 3 to 2 still holds (and can
         serve) its old s3 while missing s2."""
-        import json as _json
-
         cid = pg_cid(pool_id, ps)
         objects: Dict[str, Dict] = {}
         last_update = NULL_VERSION
@@ -741,19 +736,19 @@ class OSDService(MapFollower):
             for key, raw in sorted(
                     self.store.omap_get(cid, "pglog").items()):
                 try:
-                    rec = _json.loads(raw.decode())
-                except ValueError:
+                    rec = PgLogEntry.decode_blob(raw)
+                except MalformedInput:
                     continue
-                v = rec.get("v", NULL_VERSION)
-                oid = rec.get("oid")
-                if oid is None:
+                v = rec.v or NULL_VERSION
+                if not rec.oid:
                     continue
+                oid = rec.oid
                 cur = objects.get(oid)
                 if cur is None or v >= cur["v"]:
                     objects[oid] = {
                         "v": v,
-                        "deleted": rec.get("op") == "delete",
-                        "size": rec.get("size", 0), "shards": {}}
+                        "deleted": rec.deleted,
+                        "size": rec.size, "shards": {}}
                 if v > last_update:
                     last_update = v
             # what the store actually holds, per position and version
@@ -780,17 +775,15 @@ class OSDService(MapFollower):
     def _log_keys_above(self, cid: str, oid: str, v: str):
         """PG-log keys recording ``oid`` at versions above ``v`` (the
         torn entries an authoritative rollback must erase)."""
-        import json as _json
-
         drop = []
         if not self.store.collection_exists(cid):
             return drop
         for key, raw in self.store.omap_get(cid, "pglog").items():
             try:
-                rec = _json.loads(raw.decode())
-            except ValueError:
+                rec = PgLogEntry.decode_blob(raw)
+            except MalformedInput:
                 continue
-            if rec.get("oid") == oid and rec.get("v", "") > v:
+            if rec.oid == oid and rec.v > v:
                 drop.append(key)
         return drop
 
@@ -801,8 +794,6 @@ class OSDService(MapFollower):
         weight in omap space."""
         pool_id, ps = int(msg["pool"]), int(msg["ps"])
         cid = pg_cid(pool_id, ps)
-        import json as _json
-
         with self._pg_lock(pool_id, ps):
             if not self.store.collection_exists(cid):
                 return None
@@ -810,21 +801,19 @@ class OSDService(MapFollower):
             newest: Dict[str, str] = {}
             for key, raw in log.items():
                 try:
-                    rec = _json.loads(raw.decode())
-                except ValueError:
+                    rec = PgLogEntry.decode_blob(raw)
+                except MalformedInput:
                     continue
-                oid = rec.get("oid")
-                v = rec.get("v", "")
-                if oid and v >= newest.get(oid, ""):
-                    newest[oid] = v
+                if rec.oid and rec.v >= newest.get(rec.oid, ""):
+                    newest[rec.oid] = rec.v
             drop = []
             for key, raw in log.items():
                 try:
-                    rec = _json.loads(raw.decode())
-                except ValueError:
+                    rec = PgLogEntry.decode_blob(raw)
+                except MalformedInput:
                     drop.append(key)
                     continue
-                if rec.get("v", "") < newest.get(rec.get("oid"), ""):
+                if rec.v < newest.get(rec.oid, ""):
                     drop.append(key)
             if drop:
                 txn = Transaction()

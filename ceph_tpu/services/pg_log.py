@@ -1,0 +1,70 @@
+"""PG log entries — the pg_log_entry_t wire/disk form.
+
+The role of src/osd/osd_types.h pg_log_entry_t: each write/delete
+appends one record to the PG's omap-resident log; peering consumes the
+per-object newest record (tombstones included) to compute missing
+sets, and trim drops superseded history.  Before this module the OSD
+serialized these records as ad-hoc ``json.dumps`` dicts — no version,
+no compat floor, no registry entry — exactly the drift class the
+wirecheck layer exists to close.
+
+Records now travel through the versioned envelope (wirecheck entry
+``osd.pg_log_entry``); archived raw-dict records (writer v0 — every
+store written before this PR) still decode via the lenient path, so a
+remounted OSD data_dir replays its history unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+from ..common import encoding
+from ..common.encoding import MalformedInput, Versioned
+
+
+@dataclass
+class PgLogEntry(Versioned):
+    """One log record: op kind, object, version stamp, and (for
+    writes) the shard position and logical size."""
+
+    STRUCT_V = 1
+    COMPAT_V = 1
+
+    op: str = "write"        # "write" | "delete"
+    oid: str = ""
+    v: str = ""              # the version stamp (common.version)
+    shard: int = -1          # -1: not a shard-positional record
+    size: int = 0
+
+    def to_dict(self) -> dict:
+        return {"op": self.op, "oid": self.oid, "v": self.v,
+                "shard": self.shard, "size": self.size}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "PgLogEntry":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    @property
+    def deleted(self) -> bool:
+        return self.op == "delete"
+
+    # -- omap value form ----------------------------------------------
+    def encode_blob(self) -> bytes:
+        return self.encode_versioned().encode()
+
+    @classmethod
+    def decode_blob(cls, raw: bytes) -> "PgLogEntry":
+        """Lenient: pre-envelope raw-dict records (writer v0) decode
+        with the same field defaults."""
+        v, d = encoding.decode_any(raw, supported=cls.STRUCT_V,
+                                   struct="osd.pg_log_entry")
+        if not isinstance(d, dict):
+            raise MalformedInput(
+                f"osd.pg_log_entry v{v}: payload is not an object")
+        try:
+            return cls.from_dict(cls.upgrade(max(v, 1), d))
+        except (KeyError, TypeError, ValueError) as e:
+            raise MalformedInput(
+                f"osd.pg_log_entry v{v}: bad payload: {e!r}")

@@ -8,12 +8,18 @@ as comma-separated host:port pairs).
 Plus the local observability plane (no monitor needed — polls daemon
 admin sockets, ceph_tpu/tools/telemetry.py):
 
+Plus the wire-format conformance plane (no cluster needed — drives
+the ceph_tpu/analysis/wirecheck.py registry, the ceph-dencoder role):
+
 CLI:
     python -m ceph_tpu.tools.ceph_cli --mon HOST:PORT[,HOST:PORT...] \
         status | health | osd tree | osd reweight ID W | osd out ID |
         osd down ID | pool ls | pool create ID PGS SIZE | pool delete ID
     python -m ceph_tpu.tools.ceph_cli --asok-dir DIR \
         daemonperf | telemetry snapshot|prom|traces
+    python -m ceph_tpu.tools.ceph_cli \
+        dencoder list | encode TYPE | decode TYPE [HEXFILE] |
+        roundtrip [TYPE]
 """
 
 from __future__ import annotations
@@ -34,6 +40,75 @@ def _mons(spec: str):
     return out
 
 
+def _jsonable(obj):
+    """Decoded wire objects rendered for the terminal: bytes as hex,
+    to_dict forms expanded, tuples as lists."""
+    if isinstance(obj, (bytes, bytearray, memoryview)):
+        return bytes(obj).hex()
+    if hasattr(obj, "to_dict"):
+        return _jsonable(obj.to_dict())
+    if hasattr(obj, "export_state"):
+        return _jsonable(obj.export_state())
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    return obj
+
+
+def _dencoder(verb, extra) -> int:
+    """The ceph-dencoder role over the wirecheck registry: enumerate
+    registered wire types, emit an example encode, decode arbitrary
+    blobs, and run the five-property conformance check."""
+    from ..analysis import wirecheck
+
+    sub = verb[1] if len(verb) > 1 else "list"
+    if sub == "list":
+        for e in wirecheck.entries():
+            print(f"{e.name}  struct_v={e.struct_v} "
+                  f"compat_v={e.compat_v} kind={e.kind}"
+                  f"{' legacy-ok' if e.legacy else ''}")
+        return 0
+    if sub == "encode":
+        if len(verb) < 3:
+            print("dencoder encode needs a TYPE", file=sys.stderr)
+            return 2
+        e = wirecheck.get(verb[2])
+        blob = e.encode(e.factory())
+        blob = blob.encode() if isinstance(blob, str) else blob
+        print(blob.hex())
+        return 0
+    if sub == "decode":
+        if len(verb) < 3:
+            print("dencoder decode needs a TYPE", file=sys.stderr)
+            return 2
+        e = wirecheck.get(verb[2])
+        src = verb[3] if len(verb) > 3 else "-"
+        hexstr = sys.stdin.read() if src == "-" else \
+            open(src).read()
+        try:
+            obj = e.decode(bytes.fromhex(hexstr.strip()))
+        except ValueError as err:
+            print(f"decode failed: {err}", file=sys.stderr)
+            return 1
+        print(json.dumps(_jsonable(obj), indent=1))
+        return 0
+    if sub == "roundtrip":
+        targets = wirecheck.entries() if len(verb) < 3 else \
+            [wirecheck.get(verb[2])]
+        bad = 0
+        for e in targets:
+            fails = wirecheck.check(e)
+            print(f"{e.name}: "
+                  f"{'ok' if not fails else 'FAIL'}")
+            for f in fails:
+                print(f"  - {f}")
+            bad += bool(fails)
+        return 1 if bad else 0
+    print(f"unknown dencoder verb {sub!r}", file=sys.stderr)
+    return 2
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ceph")
     ap.add_argument("--mon",
@@ -46,6 +121,10 @@ def main(argv=None) -> int:
     # unknown extras (e.g. daemonperf's --interval/--count) pass
     # through to the telemetry tool's own parser
     args, extra = ap.parse_known_args(argv)
+
+    # the conformance plane runs entirely offline
+    if args.verb[0] == "dencoder":
+        return _dencoder(args.verb, extra)
 
     # the observability verbs poll admin sockets directly — no
     # monitor, no messenger

@@ -95,7 +95,11 @@ def check_all(base: pathlib.Path) -> list:
     corpus reports itself instead of passing vacuously."""
     if not base.is_dir():
         return [f"corpus base {base} does not exist"]
-    entries = sorted(p for p in base.iterdir() if p.is_dir())
+    # only EC parity entries (marked by profile.json) belong to this
+    # checker; tests/corpus/encodings/ is the WIRE corpus, owned by
+    # tests/golden/_gen_wire_corpus.py
+    entries = sorted(p for p in base.iterdir()
+                     if p.is_dir() and (p / "profile.json").exists())
     if not entries:
         return [f"corpus base {base} has no entries"]
     failures = []

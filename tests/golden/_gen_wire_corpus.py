@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Golden wire-encoding corpus generator + freshness gate.
+
+The ceph-object-corpus / check-generated.sh role: every type in the
+wirecheck registry has its current example encoding committed under
+
+    tests/corpus/encodings/<type>/<struct_v>/example.bin
+
+``--check`` re-encodes every registered type and byte-compares against
+the committed blob — tier-1 fails when an encoding changed WITHOUT a
+struct_v bump (silent wire drift), or when a new type/version has no
+committed blob yet.  ``--write`` regenerates the current-version blobs
+(never touching archived older-version directories, which exist to
+prove old blobs stay decodable forever).
+
+Regeneration workflow (after an INTENTIONAL format change):
+  1. bump the type's STRUCT_V (and COMPAT_V if old readers cannot
+     skip the change),
+  2. move nothing: the old  <type>/<old_v>/  directory stays as the
+     archived back-decode witness,
+  3. run  python tests/golden/_gen_wire_corpus.py --write
+  4. commit the new  <type>/<new_v>/example.bin  with the code.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+from typing import List
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+CORPUS = REPO / "tests" / "corpus" / "encodings"
+
+sys.path.insert(0, str(REPO))
+
+
+def _blob(entry) -> bytes:
+    raw = entry.encode(entry.factory())
+    return raw.encode() if isinstance(raw, str) else bytes(raw)
+
+
+def current_path(entry) -> pathlib.Path:
+    return CORPUS / entry.name / str(entry.struct_v) / "example.bin"
+
+
+def write() -> List[str]:
+    from ceph_tpu.analysis import wirecheck
+
+    wrote = []
+    for e in wirecheck.entries():
+        p = current_path(e)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(_blob(e))
+        wrote.append(str(p.relative_to(REPO)))
+    return wrote
+
+
+def check() -> List[str]:
+    """Empty list = fresh.  Each entry's current encoding must
+    byte-match its committed blob at the CURRENT struct_v; a registry
+    entry without a committed blob is also stale (a new type/version
+    whose pin was not committed)."""
+    from ceph_tpu.analysis import wirecheck
+
+    problems = []
+    for e in wirecheck.entries():
+        p = current_path(e)
+        if not p.exists():
+            problems.append(
+                f"{e.name}: no committed corpus blob at "
+                f"{p.relative_to(REPO)} — run "
+                f"tests/golden/_gen_wire_corpus.py --write and "
+                f"commit it")
+            continue
+        if p.read_bytes() != _blob(e):
+            problems.append(
+                f"{e.name}: current encoding diverges from the "
+                f"committed corpus at struct_v {e.struct_v}.  Either "
+                f"this change is accidental wire drift (fix the "
+                f"code), or it is intentional: bump STRUCT_V, keep "
+                f"the old version dir as the archived witness, and "
+                f"regenerate with --write")
+    return problems
+
+
+def main(argv) -> int:
+    if "--write" in argv:
+        for p in write():
+            print(f"wrote {p}")
+        return 0
+    problems = check()
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} stale corpus entr"
+              f"{'y' if len(problems) == 1 else 'ies'}")
+        return 1
+    print("wire corpus fresh")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

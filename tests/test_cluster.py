@@ -480,8 +480,10 @@ def test_health_and_pg_states(cluster):
 def test_pg_log_trim(cluster):
     """After a clean pass, each member's PG log keeps only the newest
     record per object (older history trimmed)."""
-    import json as _json
     import time as _time
+
+    from ceph_tpu.common.encoding import MalformedInput
+    from ceph_tpu.services.pg_log import PgLogEntry
 
     c = cluster.client("trim")
     for i in range(10):
@@ -501,10 +503,10 @@ def test_pg_log_trim(cluster):
                 for key, raw in svc.store.omap_get(
                         cid, "pglog").items():
                     try:
-                        rec = _json.loads(raw.decode())
-                    except ValueError:
+                        rec = PgLogEntry.decode_blob(raw)
+                    except MalformedInput:
                         continue
-                    if rec.get("oid") == "trim-obj":
+                    if rec.oid == "trim-obj":
                         per_oid.setdefault("trim-obj", []).append(key)
                 if per_oid:
                     counts.append(len(per_oid["trim-obj"]))

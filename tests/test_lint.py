@@ -1,17 +1,19 @@
 """Static lint enforcement: the concurrency rules
-(tools/lint_concurrency.py, CONC00x) and the JAX compile-hygiene
-rules (tools/lint_jax.py, JAX00x).  Rule unit tests run on synthetic
+(tools/lint_concurrency.py, CONC00x), the JAX compile-hygiene rules
+(tools/lint_jax.py, JAX00x), and the wire-schema rules
+(tools/lint_wire.py, WIRE00x).  Rule unit tests run on synthetic
 modules; the enforcement tests keep ``ceph_tpu/`` clean — a new raw
 lock, a blocking call under a lock, a device call in a messenger
-handler, or a fresh host-device sync point in a hot module fails CI
-here unless explicitly justified (``# conc-ok:`` / ``# jax-ok:``
-inline, or the committed JAX_ALLOWLIST below)."""
+handler, a fresh host-device sync point in a hot module, or ad-hoc
+JSON on a wire/disk path fails CI here unless explicitly justified
+(``# conc-ok:`` / ``# jax-ok:`` / ``# wire-ok:`` inline, or the
+committed allowlists below)."""
 
 import pathlib
 import textwrap
 
 from tools.lint_concurrency import lint_file, lint_paths
-from tools import lint_jax
+from tools import lint_jax, lint_wire
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
@@ -388,5 +390,198 @@ def test_jax_cli_exit_status(tmp_path):
     good.write_text("x = 1\n")
     p = subprocess.run(
         [sys.executable, str(REPO / "tools" / "lint_jax.py"),
+         str(good)], capture_output=True, text=True)
+    assert p.returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# wire-schema lint (tools/lint_wire.py)
+# ---------------------------------------------------------------------------
+
+# Synthetic rule tests pass the registry sets explicitly so they
+# exercise the rules, not the live registry.
+_COVERED = {"Covered"}
+_FRAMES = {"__hello__", "__ack__", "__reply__"}
+
+
+def _wlint(tmp_path, source, rel="msg/peer.py"):
+    f = tmp_path / rel
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    return lint_wire.lint_file(f, root=tmp_path, covered=_COVERED,
+                               frames=_FRAMES)
+
+
+# Known-acceptable WIRE hits in ceph_tpu/ — every one a deliberate
+# seam, marked inline with `# wire-ok:`; this committed allowlist is
+# for hits that cannot carry an inline mark.  Entries are
+# (path suffix, code, substring of the flagged line).
+WIRE_ALLOWLIST = ()
+
+
+def _wire_allowlisted(v):
+    src = (REPO / "ceph_tpu" / ".." / v.path).resolve()
+    try:
+        line = src.read_text().splitlines()[v.line - 1]
+    except (OSError, IndexError):
+        return False
+    return any(v.path.endswith(path) and v.code == code and sub in line
+               for path, code, sub in WIRE_ALLOWLIST)
+
+
+def test_repo_is_wire_clean():
+    violations = [v for v in lint_wire.lint_paths([REPO / "ceph_tpu"])
+                  if not _wire_allowlisted(v)]
+    assert not violations, "\n".join(str(v) for v in violations)
+
+
+def test_wire001_raw_json_on_wire_path(tmp_path):
+    src = """
+        import json
+
+        def save(h):
+            return json.dumps(h).encode()
+
+        def load(raw):
+            return json.loads(raw)
+    """
+    vs = _wlint(tmp_path, src, rel="os/store.py")
+    assert codes(vs) == ["WIRE001", "WIRE001"]
+    # the same source outside the wire/disk scope is not flagged
+    assert _wlint(tmp_path, src, rel="tools/cli.py") == []
+    # and the envelope seam itself is exempt
+    assert _wlint(tmp_path, src, rel="common/encoding.py") == []
+
+
+def test_wire001_tracks_json_alias(tmp_path):
+    vs = _wlint(tmp_path, """
+        import json as _json
+
+        def save(h):
+            return _json.dumps(h)
+    """, rel="osdmap/enc.py")
+    assert codes(vs) == ["WIRE001"]
+
+
+def test_wire001_suppression(tmp_path):
+    vs = _wlint(tmp_path, """
+        import json
+
+        def codec(msg):
+            return json.dumps(msg)  # wire-ok: the codec seam itself
+    """, rel="msg/frames.py")
+    assert vs == []
+
+
+def test_wire002_unregistered_wire_class(tmp_path):
+    vs = _wlint(tmp_path, """
+        class Rogue:
+            def to_dict(self):
+                return {}
+
+            @classmethod
+            def from_dict(cls, d):
+                return cls()
+
+        class Covered:
+            def to_dict(self):
+                return {}
+
+            @classmethod
+            def from_dict(cls, d):
+                return cls()
+
+        class NotWireShaped:
+            def to_dict(self):
+                return {}
+    """, rel="osdmap/types.py")
+    assert codes(vs) == ["WIRE002"]
+    assert "Rogue" in str(vs[0])
+
+
+def test_wire002_scope_is_wire_dirs_only(tmp_path):
+    src = """
+        class Rogue:
+            def to_dict(self):
+                return {}
+
+            @classmethod
+            def from_dict(cls, d):
+                return cls()
+    """
+    assert _wlint(tmp_path, src, rel="services/helper.py") == []
+
+
+def test_wire003_unregistered_frame_literal(tmp_path):
+    vs = _wlint(tmp_path, """
+        def dispatch(self, type_):
+            if type_ == "__hello__":
+                return 1
+            if type_ == "__evil__":
+                return 2
+            if type_ in ("__ack__", "__reply__"):
+                return 3
+    """)
+    assert codes(vs) == ["WIRE003"]
+    assert "__evil__" in str(vs[0])
+    # frame literals outside msg/ are not this rule's business
+    assert _wlint(tmp_path, """
+        def f(x):
+            return x == "__evil__"
+    """, rel="os/store.py") == []
+
+
+def test_wire004_swallowed_decode(tmp_path):
+    vs = _wlint(tmp_path, """
+        def read(self, raw):
+            try:
+                rec = decode(raw)
+            except Exception:
+                pass
+
+        def read2(self, raw):
+            try:
+                rec = self.codec.loads(raw)
+            except:
+                continue
+    """, rel="os/store.py")
+    assert codes(vs) == ["WIRE004", "WIRE004"]
+
+
+def test_wire004_narrow_or_surfacing_ok(tmp_path):
+    vs = _wlint(tmp_path, """
+        def read(self, raw):
+            try:
+                rec = decode(raw)
+            except MalformedInput:
+                pass
+            try:
+                rec = decode(raw)
+            except Exception as e:
+                self.log.derr(repr(e))
+            try:
+                step()
+            except Exception:
+                pass
+    """, rel="os/store.py")
+    assert vs == []
+
+
+def test_wire_cli_exit_status(tmp_path):
+    import subprocess
+    import sys
+
+    (tmp_path / "os").mkdir()
+    bad = tmp_path / "os" / "bad.py"
+    bad.write_text("import json\nx = json.dumps({})\n")
+    p = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_wire.py"),
+         str(tmp_path / "os")], capture_output=True, text=True)
+    assert p.returncode == 1
+    assert "WIRE001" in p.stdout
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    p = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_wire.py"),
          str(good)], capture_output=True, text=True)
     assert p.returncode == 0

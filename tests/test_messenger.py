@@ -358,3 +358,35 @@ def test_control_lane_survives_op_burst():
         release.set()
         client.shutdown()
         server.shutdown()
+
+
+def test_compression_bomb_drops_session_not_daemon():
+    """Satellite regression: a ~1 KiB frame whose compressed control
+    segment claims 100 MiB must be rejected at the codec (bounded
+    decompression, MalformedInput) — the unbounded zlib.decompress it
+    replaces would have allocated the full 100 MiB before any check.
+    The server keeps serving afterwards."""
+    import socket as _socket
+    import struct as _struct
+    import zlib as _zlib
+
+    from ceph_tpu.msg.messenger import MAX_DECOMPRESSED
+
+    server, client = mk_pair(lossless=False)
+    server.register("ping", lambda m: {"pong": True})
+    try:
+        plain = 100 << 20
+        assert plain > MAX_DECOMPRESSED  # the claim exceeds the cap
+        comp = _zlib.compress(b"a" * plain, 6)
+        payload = (_struct.pack("<BBI", 2, 0x01, len(comp)) + comp
+                   + _struct.pack("<I", 0))
+        assert len(payload) < 256 << 10  # a genuinely small frame
+        s = _socket.create_connection(server.addr, timeout=5)
+        s.sendall(_struct.pack(">I", len(payload)) + payload)
+        time.sleep(0.1)
+        s.close()
+        rep = client.call(server.addr, {"type": "ping"}, timeout=10)
+        assert rep.get("pong") is True
+    finally:
+        client.shutdown()
+        server.shutdown()

@@ -328,3 +328,117 @@ def test_checkpoint_compression_roundtrip(tmp_path):
     s3 = WALStore(p, compression="lzma")
     s3.mount()
     assert s3.read("c", "o") == b"A" * 100_000
+
+
+# ---------------------------------------------------------------------------
+# checkpoint robustness: bad compressor tag / truncated compressed
+# body must surface a clean error and the store must still mount from
+# the WAL (never crash, never silently drop journaled txns)
+# ---------------------------------------------------------------------------
+
+def _write_txns(st, n=3):
+    st.queue_transaction(Transaction().create_collection("pg1"))
+    for i in range(n):
+        st.queue_transaction(
+            Transaction().write("pg1", f"o{i}", 0, b"x" * 8))
+    st._wal_f.flush()
+
+
+def _corrupt_ckpt(path, mangle):
+    raw = bytearray(open(path, "rb").read())
+    mangle(raw)
+    open(path, "wb").write(bytes(raw))
+
+
+def test_checkpoint_unknown_compressor_tag_mounts_from_wal(tmp_path):
+    from ceph_tpu.common.encoding import MalformedInput
+    from ceph_tpu.os.wal_store import (_MAGIC_Z, _crc32c, _HDR,
+                                       decode_checkpoint)
+
+    st = make(tmp_path)
+    _write_txns(st)
+
+    # forge the mkfs checkpoint to claim a compressor this build
+    # lacks (a "zstd9" store opened by an older binary), crc valid
+    raw = open(st._ckpt_path, "rb").read()
+    magic, seq, ln, crc = _HDR.unpack_from(raw)
+    tag = b"zstd9"
+    body = bytes([len(tag)]) + tag + b"\x00" * 16
+    forged = _HDR.pack(_MAGIC_Z, seq, len(body),
+                       _crc32c(body)) + body
+    open(st._ckpt_path, "wb").write(forged)
+
+    # the pure codec refuses it CLEANLY (typed, names the struct)
+    with pytest.raises(MalformedInput) as ei:
+        decode_checkpoint(forged)
+    assert "os.wal_checkpoint" in str(ei.value)
+
+    # ...and the store still mounts, recovering every acked txn from
+    # the WAL, with the error surfaced on the store object
+    st2 = WALStore(st.path)
+    st2.mount()
+    assert st2.last_mount_error is not None
+    assert "zstd9" in st2.last_mount_error or \
+        "compressor" in st2.last_mount_error
+    assert st2.list_objects("pg1") == ["o0", "o1", "o2"]
+    assert st2.read("pg1", "o1") == b"x" * 8
+    # the recovered store keeps working: write + checkpoint + remount
+    st2.queue_transaction(
+        Transaction().write("pg1", "post", 0, b"p"))
+    st2.umount()  # checkpoints: the bad file is overwritten
+    st3 = WALStore(st.path)
+    st3.mount()
+    assert st3.last_mount_error is None
+    assert st3.read("pg1", "post") == b"p"
+
+
+def test_checkpoint_truncated_compressed_body_mounts_from_wal(
+        tmp_path):
+    st = make(tmp_path)
+    _write_txns(st)
+    st.checkpoint()  # fold into a real zlib checkpoint, WAL truncated
+    st.queue_transaction(
+        Transaction().write("pg1", "after", 0, b"a"))
+
+    # bit rot tears bytes off the checkpoint tail: the folded state
+    # is genuinely gone from disk.  mount() must still come up (the
+    # acked-prefix contract over what the disk still PROVES), surface
+    # the loss on last_mount_error — and never crash on the WAL
+    # record whose base state vanished with the checkpoint.
+    raw = open(st._ckpt_path, "rb").read()
+    open(st._ckpt_path, "wb").write(raw[:len(raw) - 7])
+    st2 = WALStore(st.path)
+    st2.mount()
+    assert st2.last_mount_error is not None
+    assert "undecodable" in st2.last_mount_error
+    # the store is usable again: writes, checkpoint, clean remount
+    st2.queue_transaction(Transaction().create_collection("pg2"))
+    st2.queue_transaction(
+        Transaction().write("pg2", "fresh", 0, b"f"))
+    st2.umount()
+    st3 = WALStore(st.path)
+    st3.mount()
+    assert st3.last_mount_error is None
+    assert st3.read("pg2", "fresh") == b"f"
+
+
+def test_checkpoint_valid_crc_corrupt_zlib_stream(tmp_path):
+    """crc recomputed over a damaged compressed stream (a forged or
+    torn-then-rewritten file): decompression fails -> clean fallback,
+    not a zlib.error crash."""
+    from ceph_tpu.os.wal_store import _crc32c, _HDR
+
+    st = make(tmp_path)
+    _write_txns(st)
+    raw = bytearray(open(st._ckpt_path, "rb").read())
+    magic, seq, ln, crc = _HDR.unpack_from(raw)
+    body = bytearray(raw[_HDR.size:_HDR.size + ln])
+    if len(body) > 4:
+        body[-2] ^= 0xFF  # damage inside the zlib stream
+    forged = _HDR.pack(magic, seq, len(body),
+                       _crc32c(bytes(body))) + bytes(body)
+    open(st._ckpt_path, "wb").write(forged)
+    st2 = WALStore(st.path)
+    st2.mount()  # must not raise
+    assert st2.last_mount_error is not None
+    assert st2.list_objects("pg1") == ["o0", "o1", "o2"]

@@ -1,0 +1,287 @@
+#!/usr/bin/env python
+"""Wire-schema lint — static companion to analysis/wirecheck.
+
+AST-level checks that keep every wire/disk path inside the versioned
+envelope + conformance registry, enforced by tests/test_lint.py like
+the CONC/JAX rules:
+
+WIRE001  raw ``json.dumps``/``json.loads`` in a wire/disk module
+         (msg/, os/, osdmap/, the services persistence files,
+         crush/map.py).  Ad-hoc JSON has no struct_v, no compat
+         floor, no corpus pin — the drift class this layer exists to
+         close.  The envelope seam itself (common/encoding.py,
+         common/bincode.py) is exempt; deliberate codec seams carry
+         ``# wire-ok: <reason>``.
+
+WIRE002  a class in msg/ / os/ / osdmap/ defining BOTH to_dict and
+         from_dict (a wire-shaped type) that no wirecheck registry
+         entry covers: its encoding can drift silently because
+         nothing round-trips, corpus-pins, or mutation-tests it.
+
+WIRE003  a frame-type literal (``__xxx__``) compared in msg/ without
+         a registry entry owning it: a typed frame family handled on
+         the wire but absent from the conformance surface.
+
+WIRE004  a broad handler (bare ``except:`` / ``except Exception``)
+         whose body is only pass/continue wrapped around a decode
+         call: it swallows MalformedInput, turning tampered bytes
+         into silent data loss instead of a surfaced protocol error.
+         (Narrow catches that log, break, or re-raise are fine.)
+
+Suppression: append ``# wire-ok: <reason>`` to the offending line (or
+the introducing ``class``/``try`` line).  tests/test_lint.py carries
+the committed allowlist for known-acceptable hits in ``ceph_tpu/``.
+
+Usage:
+    python tools/lint_wire.py [paths...]   # default: ceph_tpu/
+Exit status 1 when violations are found.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Set
+
+SUPPRESS_MARK = "wire-ok:"
+
+# module scope per rule, matched against the path relative to the
+# package root (endswith for files, substring for dirs)
+WIRE_DIRS = ("msg/", "os/", "osdmap/")
+WIRE_FILES = ("services/monitor.py", "services/image.py",
+              "services/osd_service.py", "services/pg_log.py",
+              "crush/map.py")
+SEAM_FILES = ("common/encoding.py", "common/bincode.py")
+
+_DECODEISH = ("decode", "loads", "from_dict", "unpack", "from_json",
+              "from_wire")
+
+
+@dataclass
+class Violation:
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _suppressed(src_lines: List[str], *linenos: int) -> bool:
+    for ln in linenos:
+        if 1 <= ln <= len(src_lines) and \
+                SUPPRESS_MARK in src_lines[ln - 1]:
+            return True
+    return False
+
+
+def _registry_sets():
+    """(covered class names, frame-type literals) from the live
+    wirecheck registry; empty sets when the package is unimportable
+    (linting a foreign tree)."""
+    try:
+        from ceph_tpu.analysis import wirecheck
+
+        return wirecheck.covered_classes(), wirecheck.frame_type_names()
+    except Exception:
+        return set(), set()
+
+
+def _in_scope(rel: str) -> bool:
+    if any(rel.endswith(f) for f in SEAM_FILES):
+        return False
+    return any(d in rel for d in WIRE_DIRS) or \
+        any(rel.endswith(f) for f in WIRE_FILES)
+
+
+def _in_dir_scope(rel: str) -> bool:
+    return any(d in rel for d in WIRE_DIRS)
+
+
+def _is_msg(rel: str) -> bool:
+    return "msg/" in rel
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, path: str, rel: str, src: str,
+                 covered: Set[str], frames: Set[str]):
+        self.path = path
+        self.rel = rel
+        self.lines = src.splitlines()
+        self.out: List[Violation] = []
+        self.covered = covered
+        self.frames = frames
+        self.scope = _in_scope(rel)
+        self.dir_scope = _in_dir_scope(rel)
+        self.msg_scope = _is_msg(rel)
+        # names bound to the json module in this file
+        self.json_names: Set[str] = set()
+
+    def _emit(self, code: str, node: ast.AST, message: str,
+              *extra_lines: int) -> None:
+        if _suppressed(self.lines, node.lineno, *extra_lines):
+            return
+        self.out.append(Violation(self.rel, node.lineno, code,
+                                  message))
+
+    # -- import tracking ----------------------------------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.name == "json":
+                self.json_names.add(alias.asname or "json")
+        self.generic_visit(node)
+
+    # -- WIRE001 -------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if self.scope and isinstance(f, ast.Attribute) and \
+                f.attr in ("dumps", "loads") and \
+                isinstance(f.value, ast.Name) and \
+                f.value.id in (self.json_names or {"json"}):
+            self._emit(
+                "WIRE001", node,
+                f"raw json.{f.attr} on a wire/disk path: no "
+                f"struct_v, no compat floor, no corpus pin — go "
+                f"through common.encoding (or mark the codec seam "
+                f"with # wire-ok:)")
+        self.generic_visit(node)
+
+    # -- WIRE002 -------------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if self.dir_scope:
+            meths = {n.name for n in node.body
+                     if isinstance(n, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))}
+            if {"to_dict", "from_dict"} <= meths and \
+                    node.name not in self.covered:
+                self._emit(
+                    "WIRE002", node,
+                    f"wire-shaped class {node.name!r} "
+                    f"(to_dict/from_dict) has no wirecheck registry "
+                    f"entry: nothing round-trips, corpus-pins, or "
+                    f"mutation-tests its encoding")
+        self.generic_visit(node)
+
+    # -- WIRE003 -------------------------------------------------------
+    def visit_Compare(self, node: ast.Compare) -> None:
+        if self.msg_scope:
+            for side in [node.left] + list(node.comparators):
+                lits = []
+                if isinstance(side, ast.Constant) and \
+                        isinstance(side.value, str):
+                    lits = [side.value]
+                elif isinstance(side, (ast.Tuple, ast.List, ast.Set)):
+                    lits = [e.value for e in side.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)]
+                for lit in lits:
+                    if lit.startswith("__") and lit.endswith("__") \
+                            and lit not in self.frames:
+                        self._emit(
+                            "WIRE003", node,
+                            f"frame-type literal {lit!r} handled "
+                            f"without a wirecheck registry entry: "
+                            f"the frame family is on the wire but "
+                            f"off the conformance surface")
+        self.generic_visit(node)
+
+    # -- WIRE004 -------------------------------------------------------
+    def visit_Try(self, node: ast.Try) -> None:
+        if self.scope and self._try_decodes(node):
+            for h in node.handlers:
+                if not self._broad(h.type):
+                    continue
+                if all(isinstance(s, (ast.Pass, ast.Continue))
+                       for s in h.body):
+                    self._emit(
+                        "WIRE004", h,
+                        "broad except swallowing MalformedInput "
+                        "around a decode: tampered bytes become "
+                        "silent data loss — narrow the catch or "
+                        "surface the error", node.lineno)
+        self.generic_visit(node)
+
+    @staticmethod
+    def _broad(t: Optional[ast.AST]) -> bool:
+        if t is None:
+            return True  # bare except
+        names = []
+        if isinstance(t, ast.Name):
+            names = [t.id]
+        elif isinstance(t, ast.Tuple):
+            names = [e.id for e in t.elts if isinstance(e, ast.Name)]
+        return any(n in ("Exception", "BaseException") for n in names)
+
+    @staticmethod
+    def _try_decodes(node: ast.Try) -> bool:
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                f = sub.func
+                name = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else "")
+                if any(d in name for d in _DECODEISH):
+                    return True
+        return False
+
+
+def lint_file(path: pathlib.Path,
+              root: Optional[pathlib.Path] = None,
+              covered: Optional[Set[str]] = None,
+              frames: Optional[Set[str]] = None) -> List[Violation]:
+    rel = str(path if root is None else path.relative_to(root))
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [Violation(rel, e.lineno or 0, "WIRE000",
+                          f"unparseable: {e.msg}")]
+    if covered is None or frames is None:
+        rc, rf = _registry_sets()
+        covered = rc if covered is None else covered
+        frames = rf if frames is None else frames
+    linter = _FileLinter(str(path), rel, src, covered, frames)
+    linter.visit(tree)
+    return sorted(linter.out, key=lambda v: v.line)
+
+
+def lint_paths(paths: Iterable[pathlib.Path],
+               covered: Optional[Set[str]] = None,
+               frames: Optional[Set[str]] = None) -> List[Violation]:
+    if covered is None or frames is None:
+        rc, rf = _registry_sets()
+        covered = rc if covered is None else covered
+        frames = rf if frames is None else frames
+    out: List[Violation] = []
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_dir():
+            root = p.parent
+            for f in sorted(p.rglob("*.py")):
+                out.extend(lint_file(f, root=root, covered=covered,
+                                     frames=frames))
+        else:
+            out.extend(lint_file(p, covered=covered, frames=frames))
+    return out
+
+
+def main(argv: List[str]) -> int:
+    targets = [pathlib.Path(a) for a in argv] or \
+        [pathlib.Path(__file__).resolve().parents[1] / "ceph_tpu"]
+    violations = lint_paths(targets)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} wire-schema lint violation(s)")
+        return 1
+    print("wire-schema lint clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+    raise SystemExit(main(sys.argv[1:]))
