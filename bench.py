@@ -211,6 +211,7 @@ def worker_staged():
                iters=4, tag="small")
     _try_stage("ec/large", _stage_ec, plat, chunk=1 << 20, batch=4,
                iters=8, tag="large")
+    _try_stage("ec/batch", _stage_ec_batch, plat)
 
 
 def worker_crush_cpu(batch=None, iters=None):
@@ -367,22 +368,69 @@ def _stage_ec_profiles():
           repair_reads=len(need), total_chunks=n)
 
 
+def _stage_ec_batch(plat, k=4, m=2, n_stripes=64, chunk=1024,
+                    iters=16):
+    """Batched vs per-stripe encode on small stripes (64 x 4 KiB by
+    default): dispatch overhead dominates tiny launches, and
+    ``encode_batched`` amortizes it into ONE launch — the data-plane
+    coalescing win, measured."""
+    import numpy as np
+
+    from ceph_tpu.ec.rs_jax import RSCode
+
+    bc = RSCode(k, m)._bit
+    rng = np.random.default_rng(2)
+    stripes = rng.integers(0, 256, (n_stripes, k, chunk),
+                           dtype=np.uint8)
+    dev = [s for s in stripes]  # per-stripe views
+
+    def sync(v):
+        getattr(v, "block_until_ready", lambda: None)()
+
+    # warm both shapes (compiles excluded from the measurement)
+    sync(bc.encode(dev[0]))
+    sync(bc.encode_batched(stripes))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        for s in dev:
+            out = bc.encode(s)
+    sync(out)
+    per_stripe = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = bc.encode_batched(stripes)
+    sync(out)
+    batched = time.perf_counter() - t0
+    nbytes = n_stripes * k * chunk * iters
+    _emit(stage="ec_batch", platform=plat, k=k, m=m,
+          n_stripes=n_stripes, chunk=chunk,
+          per_stripe_gbps=round(nbytes / per_stripe / 1e9, 3),
+          batched_gbps=round(nbytes / batched / 1e9, 3),
+          speedup=round(per_stripe / batched, 2))
+
+
 def worker_ec_cpu():
     _stage_ec("cpu")
+    _try_stage("ec/batch", _stage_ec_batch, "cpu")
     _try_stage("ec/profiles", _stage_ec_profiles)
 
 
 def worker_cluster():
     """End-to-end MiniCluster throughput (the rados-bench analogue,
-    src/common/obj_bencher.cc role): write + seq-read IOPS/latency."""
+    src/common/obj_bencher.cc role): a pipelined-write queue-depth
+    sweep (the aio window keeps the OSD queues full; the knee of the
+    curve is the write pipeline's capacity) + seq-read IOPS/latency."""
     from ceph_tpu.tools.rados_bench import bench_minicluster
 
     out = bench_minicluster(op="seq", seconds=2.0, concurrent=8,
-                            object_size=1 << 16, n_osds=4)
+                            object_size=1 << 16, n_osds=4,
+                            qd_sweep=[8, 16, 32])
     _emit(stage="cluster",
           write_iops=out["write"].get("iops"),
           write_mbps=out["write"].get("mb_per_sec"),
           write_p99_ms=out["write"].get("lat_p99_ms"),
+          write_qd=out["write"].get("qd"),
+          qd_sweep=out.get("qd_sweep"),
           seq_iops=out.get("seq", {}).get("iops"),
           seq_mbps=out.get("seq", {}).get("mb_per_sec"),
           seq_p99_ms=out.get("seq", {}).get("lat_p99_ms"),
@@ -597,6 +645,9 @@ def main():
         ec_res = large or ec_res
         acc.kill("ec stages resolved")
     prof_res = []
+    batch_res = None
+    if acc is not None:
+        batch_res = acc.find(lambda r: r.get("stage") == "ec_batch")
     if ec_res is None:
         ecw = Stream(_spawn("ec_cpu", "cpu"), "ec/cpu")
         ec_res = ecw.wait(is_ec, EC_DEADLINE)
@@ -607,6 +658,9 @@ def main():
                  (time.perf_counter() - ecw.t0) + 60)
         prof_res = [r for r in ecw.results
                     if r.get("stage") == "ec_profile"]
+        if batch_res is None:
+            batch_res = ecw.find(
+                lambda r: r.get("stage") == "ec_batch")
         ecw.kill("done")
     else:
         # the accelerator worker covered the headline EC stage; the
@@ -628,18 +682,30 @@ def main():
         extras = {k: v for k, v in r.items()
                   if k not in ("stage", "profile", "_t")}
         print(f"# ec {r['profile']}: {extras}", file=sys.stderr)
+    if batch_res is not None:
+        print(f"# ec batched encode {batch_res['n_stripes']}x"
+              f"{batch_res['k']}x{batch_res['chunk']}B: "
+              f"{batch_res['batched_gbps']} GB/s batched vs "
+              f"{batch_res['per_stripe_gbps']} GB/s per-stripe "
+              f"({batch_res['speedup']}x) on "
+              f"{batch_res['platform']}", file=sys.stderr)
     if acc is not None:
         acc.kill("bench done")
 
-    # cluster throughput phase (secondary; rados-bench analogue)
+    # cluster throughput phase (secondary; rados-bench analogue):
+    # pipelined-write qd sweep + seq read
     clw = Stream(_spawn("cluster", "cpu"), "cluster/cpu")
-    cl_res = clw.wait(lambda r: r.get("stage") == "cluster", 90)
+    cl_res = clw.wait(lambda r: r.get("stage") == "cluster", 120)
     clw.kill("done")
     if cl_res is not None:
         print(f"# cluster 4-osd: write {cl_res['write_iops']} IOPS "
               f"({cl_res['write_mbps']} MB/s, p99 "
-              f"{cl_res['write_p99_ms']} ms); seq {cl_res['seq_iops']}"
+              f"{cl_res['write_p99_ms']} ms) at qd="
+              f"{cl_res.get('write_qd')}; qd sweep "
+              f"{cl_res.get('qd_sweep')}; seq {cl_res['seq_iops']}"
               f" IOPS ({cl_res['seq_mbps']} MB/s)", file=sys.stderr)
+        print("# cluster json: " + json.dumps(cl_res),
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

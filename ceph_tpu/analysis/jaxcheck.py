@@ -579,8 +579,37 @@ def _contract_crush_mapper_spec() -> List[Case]:
     return out
 
 
+def _contract_encode_batched() -> List[Case]:
+    """The batched-encode entry (engine.BitCode.encode_batched): B
+    same-shape stripes stack on a leading batch axis, flatten to one
+    (k, B*L) launch of the SAME mod-2 kernel, and split back — the
+    exact composition the data-plane coalescer dispatches."""
+    from ..ec.engine import _mod2_matmul
+    from ..ec.rs_jax import RSCode
+
+    out: List[Case] = []
+    for k, m, B, L in ((2, 1, 4, 4096), (4, 2, 8, 4096),
+                       (8, 3, 16, 1024)):
+        bc = RSCode(k, m)._bit
+        layout, enc = bc.layout, bc._enc_dev
+
+        def encb(stripes, bc=bc, layout=layout, enc=enc, B=B, L=L):
+            flat = stripes.transpose(1, 0, 2).reshape(bc.k, B * L)
+            rows = layout.to_rows(flat)
+            par = layout.from_rows(_mod2_matmul(enc, rows), bc.m,
+                                   B * L)
+            return par.reshape(bc.m, B, L).transpose(1, 0, 2)
+
+        out.append(Case(
+            f"rs(k={k},m={m})/B={B}/L={L}", encb,
+            [_u8(B, k, L)], [((B, m, L), "uint8")]))
+    return out
+
+
 def _register_builtin_contracts() -> None:
     register_contract("ec.engine.mod2_matmul", _contract_mod2_matmul)
+    register_contract("ec.engine.encode_batched",
+                      _contract_encode_batched)
     register_contract("ec.rs_jax", _contract_rs_jax)
     register_contract("ec.jerasure", _contract_jerasure)
     register_contract("ec.isa", _contract_isa)

@@ -102,6 +102,19 @@ OPTIONS: Dict[str, Option] = _opts(
            "daemons bind their unix admin socket on start (perf dump, "
            "dump_tracing, dump_ops_in_flight, dump_blocked ... — the "
            "surface the telemetry tool polls)"),
+    Option("wal_group_commit_max_delay_us", int, 0,
+           "microseconds the WAL group-commit leader waits for more "
+           "transactions to join before the shared fsync; 0 = no "
+           "artificial delay (the group is whatever queued while the "
+           "previous fsync ran — the kv_sync_thread dynamics)"),
+    Option("client_aio_window", int, 16,
+           "default bounded in-flight window for Client.aio_put / "
+           "aio_write (the objecter max-in-flight role): how many "
+           "async ops may be outstanding before aio_* blocks"),
+    Option("ec_encode_batch_max_delay_us", int, 0,
+           "microseconds the OSD's EC encode coalescer waits for more "
+           "same-pool writes to join a batched encode dispatch; 0 = "
+           "coalesce only what queued during the previous dispatch"),
 )
 
 

@@ -52,3 +52,29 @@ class Throttle:
     def get_current(self) -> int:
         with self._cond:
             return self.current
+
+    def wait_until_drained(self, timeout: float | None = None) -> bool:
+        """Block until every held unit is returned (the in-flight
+        window is empty) — the flush/quiesce primitive async callers
+        need; False on timeout."""
+        with self._cond:
+            return self._cond.wait_for(lambda: self.current == 0,
+                                       timeout)
+
+    def hold(self, count: int = 1, timeout: float | None = None):
+        """``with throttle.hold():`` — get on entry, put on exit.
+        Raises TimeoutError when the budget never admits ``count``."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _held():
+            if not self.get(count, timeout):
+                raise TimeoutError(
+                    f"throttle {self.name}: {count} unit(s) not "
+                    f"granted within {timeout}s")
+            try:
+                yield self
+            finally:
+                self.put(count)
+
+        return _held()

@@ -36,6 +36,7 @@ traced through them.
 from __future__ import annotations
 
 import collections
+import itertools
 import random
 import threading
 import time
@@ -51,8 +52,15 @@ _tracers: "weakref.WeakSet" = weakref.WeakSet()
 _tracers_lock = make_lock("tracing::registry")
 
 
+_id_prefix = uuid.uuid4().hex[:8]
+_id_counter = itertools.count(1)
+
+
 def _gen_id() -> str:
-    return uuid.uuid4().hex[:16]
+    # random per-process prefix + counter: collision-safe for span
+    # correlation at a fraction of uuid4's cost (ids are minted
+    # several times per traced op on the data path)
+    return f"{_id_prefix}{next(_id_counter):08x}"
 
 
 class Span:

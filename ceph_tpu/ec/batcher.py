@@ -1,0 +1,113 @@
+"""EncodeBatcher — cross-thread EC encode coalescing.
+
+The dispatch-side twin of the WAL group commit (os/wal_store.py) and
+the RapidRAID-style pipelining motivation (arXiv:1207.6744): an OSD
+primary serving many concurrent EC writes pays one XLA/engine dispatch
+per object, and dispatch overhead — not arithmetic — dominates small
+stripes.  Concurrent ``encode`` calls queue here; the first waiter to
+take the leader mutex drains the queue, groups requests by (code,
+object size), and runs ONE ``encode_batched`` per group (byte-identical
+to per-object encode — see ErasureCode.encode_batched), completing
+every waiter.  A lone caller is its own leader: the depth-1 path is a
+plain ``encode`` with no added latency.
+
+Batches are padded up to the next power of two with zero objects (a
+zero object's chunks are zero for every linear code; the pad outputs
+are discarded) so the device sees a BOUNDED set of batch-shape
+signatures — the PR-3 recompile-budget contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..analysis.lockdep import make_lock
+from .engine import book_batch
+
+MAX_BATCH = 16  # objects per batched dispatch (pow2-padded)
+
+
+class _EncodeReq:
+    __slots__ = ("code", "want", "raw", "done", "out", "error")
+
+    def __init__(self, code, want, raw: bytes):
+        self.code = code
+        self.want = want
+        self.raw = raw
+        self.done = threading.Event()
+        self.out: Optional[Dict] = None
+        self.error: Optional[BaseException] = None
+
+
+class EncodeBatcher:
+    def __init__(self, max_delay_us: int = 0,
+                 max_batch: int = MAX_BATCH):
+        self._mutex = make_lock("ec::batch_leader")
+        self._qlock = make_lock("ec::batch_q")
+        self._q: List[_EncodeReq] = []
+        self._delay = max(0, max_delay_us) / 1e6
+        self._max_batch = max(1, max_batch)
+
+    def encode(self, code, want_to_encode, raw: bytes) -> Dict:
+        """Drop-in for ``code.encode(want, raw)``: queue, then either
+        lead a batched dispatch for everyone queued or wait for a
+        concurrent leader to cover this request."""
+        req = _EncodeReq(code, set(want_to_encode), bytes(raw))
+        with self._qlock:
+            self._q.append(req)
+        while not req.done.is_set():
+            if self._mutex.acquire(timeout=0.05):
+                try:
+                    if not req.done.is_set():
+                        self._drain()
+                finally:
+                    self._mutex.release()
+        if req.error is not None:
+            raise req.error
+        return req.out
+
+    def _drain(self) -> None:
+        if self._delay > 0:
+            # widen the batch: let concurrent writers land their
+            # requests before the shared dispatch (bounded by the knob)
+            time.sleep(self._delay)  # conc-ok: the leader mutex is the coalescing role, not a data lock; waiting here IS the batching window
+        with self._qlock:
+            batch, self._q = self._q, []
+        if not batch:
+            return
+        groups: Dict[Tuple, List[_EncodeReq]] = {}
+        for r in batch:
+            groups.setdefault(
+                (id(r.code), len(r.raw), tuple(sorted(r.want))),
+                []).append(r)
+        for reqs in groups.values():
+            try:
+                self._run_group(reqs)
+            except Exception as e:
+                for r in reqs:
+                    r.error = e
+            finally:
+                for r in reqs:
+                    r.done.set()
+
+    def _run_group(self, reqs: List[_EncodeReq]) -> None:
+        code = reqs[0].code
+        want = reqs[0].want
+        if len(reqs) == 1:
+            reqs[0].out = code.encode(want, reqs[0].raw)
+            book_batch(1)
+            return
+        for lo in range(0, len(reqs), self._max_batch):
+            part = reqs[lo:lo + self._max_batch]
+            raws = [r.raw for r in part]
+            # pad to the next power of two with zero objects so batch
+            # shapes come from a bounded set (recompile budget); the
+            # pad rows cost arithmetic, not compiles, and are dropped
+            pad = (1 << (len(raws) - 1).bit_length()) - len(raws)
+            raws += [bytes(len(raws[0]))] * pad
+            outs = code.encode_batched(want, raws)
+            for r, out in zip(part, outs):
+                r.out = out
+            book_batch(len(part))

@@ -54,10 +54,20 @@ for _k in ("encode_time", "decode_time", "jit_compile_time"):
     _pc.add_time(_k)
 _pc.add_histogram("encode_lat")
 _pc.add_histogram("decode_lat")
+# stripes per batched-encode dispatch (value 1 = the per-stripe path):
+# the depth-1-regression canary the aio smoke test gates on
+_pc.add_histogram("ec_batch_size", min_value=1)
 # signatures already traced+compiled; set membership races only
 # double-count a compile, they never corrupt (CPython set ops are
 # atomic)
 _seen_sigs: set = set()
+
+
+def book_batch(n_stripes: int) -> None:
+    """Record one batched-encode dispatch of ``n_stripes`` stripes
+    (the EncodeBatcher and the engine-level batched path both book
+    here; per-stripe fallbacks book 1)."""
+    _pc.hist_add("ec_batch_size", n_stripes)
 
 
 def _account(kind: str, sig: tuple, dt: float, nbytes: int,
@@ -216,6 +226,41 @@ class BitCode:
                   self.layout.w, self.layout.packetsize,
                   pk is not None),
                  time.monotonic() - t0, int(data.size))
+        return out
+
+    def encode_batched(self, stripes):
+        """u8[B, k, L] -> parity u8[B, m, L]: ONE kernel dispatch for
+        B same-shape stripes.
+
+        Every layout's GF(2) rows treat byte (or word, or packet)
+        columns independently, so the B stripes concatenate along the
+        byte axis — chunk row i becomes the concat of every stripe's
+        chunk i — run through the SAME jitted kernel as ``encode``
+        (one dispatch; the compile signature is keyed by (k, B*L), so
+        callers batching at fixed sizes stay inside the recompile
+        budget), and the parities split back.  Byte-identical to B
+        per-stripe ``encode`` calls: the matmul is exact integer
+        arithmetic over disjoint columns."""
+        stripes = jnp.asarray(stripes)
+        B, k, L = stripes.shape
+        assert k == self.k, (k, self.k)
+        self.layout.check(L)
+        t0 = time.monotonic()
+        flat = stripes.transpose(1, 0, 2).reshape(self.k, B * L)
+        pk = self._fused_w8()
+        if pk is not None:
+            out = pk.fused_gf2_matmul_w8(self._enc_dev, flat)
+        else:
+            rows = self.layout.to_rows(flat)
+            out = self.layout.from_rows(
+                _mod2_matmul(self._enc_dev, rows), self.m, B * L)
+        out = out.reshape(self.m, B, L).transpose(1, 0, 2)
+        _account("encode",
+                 ("encb", self.coding_bm.shape, (B, k, L),
+                  self.layout.w, self.layout.packetsize,
+                  pk is not None),
+                 time.monotonic() - t0, int(stripes.size))
+        book_batch(B)
         return out
 
     def all_chunks(self, data):

@@ -47,6 +47,7 @@ osd_client_message_size_cap role (ceph_osd.cc:582-588).
 from __future__ import annotations
 
 import collections
+import itertools
 import json
 import socket
 import struct
@@ -66,14 +67,65 @@ from ..common.tracing import Tracer
 Addr = Tuple[str, int]
 Handler = Callable[[Dict], Optional[Dict]]
 
-# per-socket send locks: sendall() on a large frame loops, so two
-# threads writing the same cached connection would interleave bytes
-# and corrupt the framing
-_send_locks: Dict[int, object] = {}
-_send_locks_guard = make_lock("msgr::send_guard")
+# per-socket writers: sendall() on a large frame loops, so two threads
+# writing the same cached connection would interleave bytes and corrupt
+# the framing.  Beyond mutual exclusion, writers COALESCE: frames for
+# one socket queue behind the current sender, and whichever thread
+# holds the writer lock flushes everything queued in ONE send — a
+# primary fanning a write out no longer pays a syscall + lock
+# round-trip per frame sharing a connection.
+#
+# Entries are reaped on conn death, hard close, AND send failure (the
+# old per-socket lock table leaked one entry per reconnect cycle: a
+# send racing reader death re-created the entry after the reader's
+# exit had reaped it, and nothing ever removed it again).
+
+
+class _SendOp:
+    __slots__ = ("buf", "done", "error")
+
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.done = threading.Event()
+        self.error: Optional[OSError] = None
+
+
+class _SockWriter:
+    __slots__ = ("lock", "q")
+
+    def __init__(self):
+        self.lock = make_lock("msgr::send")
+        self.q: "collections.deque[_SendOp]" = collections.deque()
+
+
+_sock_writers: Dict[int, _SockWriter] = {}
+_sock_writers_guard = make_lock("msgr::send_guard")
+
+
+def _writer_for(sock) -> _SockWriter:
+    with _sock_writers_guard:
+        w = _sock_writers.get(id(sock))
+        if w is None:
+            w = _sock_writers[id(sock)] = _SockWriter()
+        return w
+
+
+def _reap_writer(sock) -> None:
+    with _sock_writers_guard:
+        _sock_writers.pop(id(sock), None)
 
 _UNACKED_CAP = 512      # frames buffered per lossless peer session
 _REPLY_CACHE_CAP = 128  # replies cached per remote session
+
+# call-correlation tids: random per-process prefix + counter.  As
+# unique as a uuid4 per call for correlation purposes, at ~1/6 the
+# cost — tids are minted 3+ times per client op on the data path.
+_tid_prefix = uuid.uuid4().hex[:12]
+_tid_counter = itertools.count(1)
+
+
+def _next_tid() -> str:
+    return f"{_tid_prefix}{next(_tid_counter):x}"
 
 
 # control segments beyond this compress on the wire (map payloads and
@@ -220,25 +272,76 @@ def decode_frame(payload: bytes) -> Tuple[Dict, list]:
 
 
 def _send_frame(sock: socket.socket, msg: Dict, keyring=None) -> int:
-    """Returns the wire size (header + payload) for the byte
-    counters."""
+    """Queue the frame on the socket's writer and flush — coalescing
+    with whatever else is queued — as the writer-lock holder.  Returns
+    the wire size (header + payload) for the byte counters; raises the
+    send failure on the CALLER's thread even when another thread's
+    flush carried (and failed) this frame."""
     payload = encode_frame(msg, keyring)
-    with _send_locks_guard:
-        lock = _send_locks.get(id(sock))
-        if lock is None:
-            lock = _send_locks[id(sock)] = make_lock("msgr::send")
-    with lock:
-        sock.sendall(struct.pack(">I", len(payload)) + payload)
+    buf = struct.pack(">I", len(payload)) + payload
+    w = _writer_for(sock)
+    # uncontended fast path: writer idle, nothing queued — send
+    # directly with no completion bookkeeping (the common case; the
+    # coalescing machinery below only engages under write contention)
+    if not w.q and w.lock.acquire(blocking=False):
+        fast = False
+        try:
+            if not w.q:
+                fast = True
+                sock.sendall(buf)
+        except OSError:
+            _reap_writer(sock)
+            raise
+        finally:
+            w.lock.release()
+        if fast:
+            return len(payload) + 4
+    op = _SendOp(buf)
+    w.q.append(op)  # deque.append is atomic; order = send order
+    while not op.done.is_set():
+        if not w.lock.acquire(timeout=0.05):
+            continue
+        try:
+            while not op.done.is_set():
+                batch = []
+                try:
+                    while True:
+                        batch.append(w.q.popleft())
+                except IndexError:
+                    pass
+                if not batch:
+                    break
+                err: Optional[OSError] = None
+                try:
+                    # ONE gathered send for the whole batch (the
+                    # writev role): the dominant cost of small frames
+                    # is per-send syscall + wakeup, not bytes
+                    sock.sendall(b"".join(o.buf for o in batch))
+                except OSError as e:
+                    err = e
+                for o in batch:
+                    o.error = err
+                    o.done.set()
+        finally:
+            w.lock.release()
+    if op.error is not None:
+        _reap_writer(sock)  # dead socket: never strand its entry
+        raise op.error
     return len(payload) + 4
 
 
 def _recv_exact(sock: socket.socket, n: int):
-    buf = b""
-    while len(buf) < n:
-        got = sock.recv(min(1 << 20, n - len(buf)))
+    """Preallocated recv_into: a 64 KiB data frame arrives in a few
+    segments, and the old ``buf += got`` concat re-copied the prefix
+    on every one."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    pos = 0
+    while pos < n:
+        got = sock.recv_into(view[pos:])
         if not got:
             return None
-        buf += got
+        pos += got
     return buf
 
 
@@ -383,7 +486,11 @@ class Messenger:
         self._in: Dict[Tuple[str, str], _InSession] = {}
         self._in_lock = make_lock("msgr::in")
         self._pending: Dict[str, Dict] = {}
-        self._waiting: set = set()  # tids with a live waiter
+        # tid -> per-call Event: a reply wakes exactly ITS caller.
+        # (The old shared Condition notify_all'd every in-flight
+        # caller per reply — O(window) wakeups per op, which made
+        # throughput DROP as the aio window grew.)
+        self._waiters: Dict[str, threading.Event] = {}
         # id(conn) -> tids of CONN-BOUND calls (lossy calls and the
         # __hello__ handshake — no session replay behind them): when
         # the conn's reader exits these fail immediately instead of
@@ -391,8 +498,7 @@ class Messenger:
         # put() once waited 20s on an OSD killed mid-call, and a
         # resync handshake waited 5s holding the session lock.
         self._conn_waiters: Dict[int, set] = {}
-        self._pending_cv = threading.Condition(
-            make_lock("msgr::pending"))
+        self._pending_lock = make_lock("msgr::pending")
         # lazy dispatch pools (DispatchQueue role); created on first
         # inbound op so pure clients never spawn them.  Two lanes: the
         # wide op pool, and a small CONTROL pool reserved for
@@ -481,19 +587,18 @@ class Messenger:
                     # must survive it
                     self.log.derr(f"{self.name}: dropping bad frame "
                                   f"({msg.get('type')!r}): {e!r}")
-        with _send_locks_guard:
-            _send_locks.pop(id(conn), None)
+        _reap_writer(conn)
         with self._conn_lock:
             self._accepted.discard(conn)
             tids = self._conn_waiters.pop(id(conn), set())
         if tids:
-            with self._pending_cv:
+            with self._pending_lock:
                 for tid in tids:
-                    if tid in self._waiting and \
-                            tid not in self._pending:
+                    ev = self._waiters.get(tid)
+                    if ev is not None and tid not in self._pending:
                         self._pending[tid] = {
                             "__session_dead__": "connection lost"}
-                self._pending_cv.notify_all()
+                        ev.set()
         if addr is not None:
             self._on_conn_death(addr, conn)
 
@@ -536,11 +641,12 @@ class Messenger:
             sess.waiters.clear()
         if not tids:
             return
-        with self._pending_cv:
+        with self._pending_lock:
             for tid in tids:
-                if tid in self._waiting and tid not in self._pending:
+                ev = self._waiters.get(tid)
+                if ev is not None and tid not in self._pending:
                     self._pending[tid] = {"__session_dead__": why}
-            self._pending_cv.notify_all()
+                    ev.set()
 
     def _send(self, conn: socket.socket, msg: Dict) -> None:
         """Sign-at-wire-time send: frames are stored/buffered unsigned
@@ -559,10 +665,11 @@ class Messenger:
         msg = _restore_blobs(msg, blobs)
         type_ = msg.get("type", "")
         if type_ == "__reply__":
-            with self._pending_cv:
-                if msg["tid"] in self._waiting:  # drop stragglers
+            with self._pending_lock:
+                ev = self._waiters.get(msg["tid"])  # drop stragglers
+                if ev is not None:
                     self._pending[msg["tid"]] = msg.get("payload", {})
-                    self._pending_cv.notify_all()
+                    ev.set()
             return
         if type_ == "__ack__":
             sess = self._out.get(tuple(msg["addr"]))
@@ -730,14 +837,19 @@ class Messenger:
             if frame is not None:
                 with self._in_lock:
                     ins.cache_reply(seq, frame)
-            # ack so the sender can trim its unacked buffer
-            try:
-                self._send(conn, {"type": "__ack__",
-                                  "sess": msg.get("_sess"),
-                                  "in_seq": seq,
-                                  "addr": list(self.addr)})
-            except OSError:
-                pass
+            else:
+                # ack so the sender can trim its unacked buffer —
+                # only for fire-and-forget frames: a reply IS the
+                # receipt proof for call-type frames (the sender
+                # completes that seq on it), so the separate ack
+                # frame was pure per-op overhead
+                try:
+                    self._send(conn, {"type": "__ack__",
+                                      "sess": msg.get("_sess"),
+                                      "in_seq": seq,
+                                      "addr": list(self.addr)})
+                except OSError:
+                    pass
         if t_rx is not None:
             dt = time.monotonic() - t_rx
             self.pc.hist_add("dispatch_lat", dt)
@@ -789,6 +901,10 @@ class Messenger:
             sock.close()
         except OSError:
             pass
+        # the reader's exit also reaps, but accept-side sockets whose
+        # reader never started (shutdown mid-accept) come through here
+        # too — reap alongside the _conns cleanup, always
+        _reap_writer(sock)
 
     def _drop(self, addr: Addr) -> None:
         with self._conn_lock:
@@ -807,25 +923,21 @@ class Messenger:
                   timeout: float = 5.0) -> Dict:
         """tid-correlated exchange below the session layer (the
         handshake itself must not be sequenced)."""
-        tid = uuid.uuid4().hex
+        tid = _next_tid()
         msg = dict(msg, tid=tid, frm=self.name)
         deadline = time.monotonic() + timeout
-        with self._pending_cv:
-            self._waiting.add(tid)
+        ev = threading.Event()
+        with self._pending_lock:
+            self._waiters[tid] = ev
         sock = None
         try:
             sock = self._connect(addr)
             self._bind_waiter(sock, tid)
             self._send(sock, msg)
-            with self._pending_cv:
-                while tid not in self._pending:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0 or not self._pending_cv.wait(
-                            timeout=min(0.5, remaining)):
-                        if time.monotonic() >= deadline:
-                            raise TimeoutError(
-                                f"{self.name}: no hello reply from "
-                                f"{addr}")
+            if not ev.wait(max(0.0, deadline - time.monotonic())):
+                raise TimeoutError(
+                    f"{self.name}: no hello reply from {addr}")
+            with self._pending_lock:
                 rep = self._pending.pop(tid)
             if isinstance(rep, dict) and \
                     "__session_dead__" in rep:  # wire-ok: local pending-table marker, never framed
@@ -835,8 +947,8 @@ class Messenger:
         finally:
             if sock is not None:
                 self._unbind_waiter(sock, tid)
-            with self._pending_cv:
-                self._waiting.discard(tid)
+            with self._pending_lock:
+                self._waiters.pop(tid, None)
                 self._pending.pop(tid, None)
 
     def _bind_waiter(self, sock, tid: str) -> None:
@@ -970,13 +1082,14 @@ class Messenger:
 
     def _call(self, addr: Addr, msg: Dict,
               timeout: float = 10.0) -> Dict:
-        tid = uuid.uuid4().hex
+        tid = _next_tid()
         deadline = time.monotonic() + timeout
         seq = None
         sock = None
         sess = self._session(addr) if self.lossless else None
-        with self._pending_cv:
-            self._waiting.add(tid)
+        ev = threading.Event()
+        with self._pending_lock:
+            self._waiters[tid] = ev
         try:
             if self.lossless:
                 with sess.buf_lock:
@@ -997,15 +1110,11 @@ class Messenger:
                 # lossy: no replay behind this call — it dies with
                 # its connection instead of waiting out the timeout
                 self._bind_waiter(sock, tid)
-            with self._pending_cv:
-                while tid not in self._pending:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0 or not self._pending_cv.wait(
-                            timeout=min(0.5, remaining)):
-                        if time.monotonic() >= deadline:
-                            raise TimeoutError(
-                                f"{self.name}: no reply from {addr} "
-                                f"for {msg['type']}")
+            if not ev.wait(max(0.0, deadline - time.monotonic())):
+                raise TimeoutError(
+                    f"{self.name}: no reply from {addr} "
+                    f"for {msg['type']}")
+            with self._pending_lock:
                 rep = self._pending.pop(tid)
             if isinstance(rep, dict) and \
                     "__session_dead__" in rep:  # wire-ok: local pending-table marker, never framed
@@ -1026,8 +1135,8 @@ class Messenger:
                     sess.waiters.discard(tid)
             if sock is not None:
                 self._unbind_waiter(sock, tid)
-            with self._pending_cv:
-                self._waiting.discard(tid)
+            with self._pending_lock:
+                self._waiters.pop(tid, None)
                 self._pending.pop(tid, None)
 
     def shutdown(self) -> None:

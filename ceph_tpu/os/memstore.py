@@ -125,10 +125,15 @@ class MemStore(ObjectStore):
         elif kind == OP_WRITE:
             _, cid, oid, offset, data = op
             o = self._obj(staged, copied, cid, oid, create=True)
-            end = offset + len(data)
-            if len(o.data) < end:
-                o.data.extend(b"\0" * (end - len(o.data)))
-            o.data[offset:end] = data
+            if offset == 0 and len(o.data) <= len(data):
+                # full replace (the data-path common case): one copy,
+                # no zero-fill pass
+                o.data = bytearray(data)
+            else:
+                end = offset + len(data)
+                if len(o.data) < end:
+                    o.data.extend(b"\0" * (end - len(o.data)))
+                o.data[offset:end] = data
         elif kind == OP_ZERO:
             _, cid, oid, offset, length = op
             # extends past EOF like the reference's _zero-via-_write

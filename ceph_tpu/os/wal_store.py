@@ -29,15 +29,28 @@ from __future__ import annotations
 
 import os
 import struct
-from typing import Dict, List, Optional, Tuple
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
 
-from ..analysis.lockdep import make_rlock
+from ..analysis.lockdep import make_lock, make_rlock
 from ..common.bincode import (DecodeError, Decoder, Encoder, decode_txn,
                               encode_txn)
 from ..common.encoding import MalformedInput
 from ..common.log import getLogger
+from ..common.perf_counters import collection
 from .memstore import MemStore, _Object
 from .objectstore import ObjectStore, Transaction
+
+# process-global WAL metrics (every in-process store shares them;
+# daemons' `perf dump` merges the global collection, the ec.engine
+# pattern): txn count, shared fsyncs, and the group-size histogram —
+# the depth-1-regression canary the aio smoke test gates on
+_pc = collection().create("os.wal")
+for _k in ("txns", "group_commits"):
+    _pc.add_u64_counter(_k)
+_pc.add_time("group_commit_time")
+_pc.add_histogram("wal_group_size", min_value=1)
 
 _MAGIC = 0x57414C31   # "WAL1": raw body
 _MAGIC_Z = 0x57414C5A  # "WALZ": compressed body (compressor name
@@ -185,9 +198,27 @@ def decode_checkpoint(raw: bytes
     return seq, colls
 
 
+class _TxnWaiter:
+    """One queued transaction's completion: set (durable) or errored
+    by whichever group-commit leader's fsync — or checkpoint — covered
+    it."""
+
+    __slots__ = ("done", "error")
+
+    def __init__(self):
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+
+    def finish(self, error: Optional[BaseException] = None) -> None:
+        if error is not None and self.error is None:
+            self.error = error
+        self.done.set()
+
+
 class WALStore(ObjectStore):
     def __init__(self, path: str, checkpoint_every_bytes: int = 1 << 24,
-                 sync: bool = True, compression: str = "zlib"):
+                 sync: bool = True, compression: str = "zlib",
+                 group_commit_max_delay_us: int = 0):
         from ..common.compressor import Compressor
 
         self.path = path
@@ -203,12 +234,29 @@ class WALStore(ObjectStore):
         self._wal_path = os.path.join(path, "wal.log")
         self._ckpt_path = os.path.join(path, "checkpoint")
         self._wal_f = None
-        self._seq = 0  # last durable txn seq
+        self._seq = 0  # newest journaled+visible txn seq
         self._ckpt_seq = 0
         self._wal_bytes = 0
         self._ckpt_every = checkpoint_every_bytes
         self._sync = sync
         self._lock = make_rlock("os::wal")
+        # -- group commit (the kv_sync_thread role, leader-elected) --
+        # appended-but-not-yet-fsynced txns awaiting the shared fsync;
+        # guarded by the store lock.  The first waiter to take the
+        # sync mutex plays kv_sync_thread for everyone queued (a
+        # dedicated thread would leak into every abandoned test
+        # store); with one writer the leader is the writer itself —
+        # the synchronous depth-1 fallback, identical to the old
+        # fsync-per-txn path.
+        self._pending: List[Tuple[int, _TxnWaiter]] = []
+        self._sync_mutex = make_lock("os::wal_sync")
+        self._wal_gen = 0  # bumped whenever _wal_f is replaced, so a
+        # leader fsyncing a stale fd can tell a swap from a failure
+        self._group_delay = max(0, group_commit_max_delay_us) / 1e6
+        # test seam: runs between the group's last append and the
+        # shared fsync (crash-consistency fault injection)
+        self._fault_before_sync: Optional[Callable[[List[int]],
+                                                   None]] = None
 
     # -- lifecycle ----------------------------------------------------
     def mkfs(self) -> None:
@@ -245,44 +293,127 @@ class WALStore(ObjectStore):
                 self._wal_f.close()
                 self._wal_f = None
 
-    # -- the write path -----------------------------------------------
+    # -- the write path (group commit) --------------------------------
     def queue_transaction(self, txn: Transaction) -> None:
+        """Append under the store lock, share the fsync.
+
+        Concurrent transactions append to the log back to back (the
+        store lock is the journal order) but the fsync — the ack
+        point — is COALESCED: the first waiter to take the sync mutex
+        fsyncs once for every record appended so far and completes
+        all their waiters (BlueStore's kv_sync_thread aggregation,
+        leader-elected).  N concurrent shard writes cost ~1-2 fsyncs
+        instead of N.  Returning still means durable: this call blocks
+        until a shared fsync (or a checkpoint) covered the record."""
+        waiter = None
         with self._lock:
             assert self._wal_f is not None, "not mounted"
             # 1. encode (an unencodable txn never journals) and
-            #    validate + stage in memory (atomic: all ops or none;
-            #    nothing visible yet)
+            #    validate + stage in memory (atomic: all ops or none)
             seq = self._seq + 1
             rec = encode_record(seq, txn.ops)
             commit = self._mem.prepare_transaction(txn)
-            # 2. journal; the fsync below is the ack point.  Journal
-            #    BEFORE the visible swap: if the append fails (ENOSPC,
-            #    EIO) the store state still equals the journal, and if
-            #    we crash right after the fsync the replay applies the
-            #    exact staged ops.
+            # 2. journal the record (buffered write + flush; the
+            #    shared fsync below is the ack point).  Journal BEFORE
+            #    the visible swap: if the append fails (ENOSPC, EIO)
+            #    the store state still equals the journal.
             try:
                 self._wal_f.write(rec)
                 self._wal_f.flush()
-                if self._sync:
-                    os.fsync(self._wal_f.fileno())  # conc-ok: the fsync IS the txn ack point and the store lock IS the journal order (callers serialize at the PG, not here)
             except Exception:
-                # the append may have partially landed (buffered bytes,
-                # EIO mid-fsync).  Roll the log back to the last valid
-                # record boundary so the failed txn can never replay and
-                # later records are never stranded behind torn bytes;
-                # if even that fails, poison the store (unmounted).
+                # the append may have partially landed (buffered
+                # bytes, EIO).  Roll the log back to the last valid
+                # record boundary — the end of the last GOOD append,
+                # fsynced or not: earlier group members' records must
+                # survive the cut — so the failed txn can never replay
+                # and later records are never stranded behind torn
+                # bytes; if even that fails, poison the store.
                 self._rollback_wal()
                 raise
-            # 3. the durable record exists: swap state in (cannot fail)
+            # 3. the journaled record exists: swap state in (cannot
+            #    fail).  Visible-before-durable, like the reference's
+            #    on_applied vs on_commit split — the caller's ack
+            #    (this call returning) still waits for the fsync.
             self._seq = seq
             commit()
             self._wal_bytes += len(rec)
+            _pc.inc("txns")
+            if self._sync:
+                waiter = _TxnWaiter()
+                self._pending.append((seq, waiter))
             if self._wal_bytes >= self._ckpt_every:
-                self.checkpoint()
+                self.checkpoint()  # completes every pending waiter
+        if waiter is None:
+            return
+        # leader-follower: whoever holds the sync mutex fsyncs for
+        # everyone queued; everyone else just waits for their waiter.
+        while not waiter.done.is_set():
+            if self._sync_mutex.acquire(timeout=0.05):
+                try:
+                    if not waiter.done.is_set():
+                        self._drain_group()
+                finally:
+                    self._sync_mutex.release()
+        if waiter.error is not None:
+            raise waiter.error
+
+    def _drain_group(self) -> None:
+        """The shared fsync, run under the sync mutex: complete every
+        transaction appended so far with ONE fsync."""
+        if self._group_delay > 0:
+            # widen the group: let concurrent writers land their
+            # appends before the shared fsync (bounded by the knob)
+            time.sleep(self._group_delay)  # conc-ok: the sync mutex is the group-commit leader role, not a data lock; waiting here IS the coalescing window
+        with self._lock:
+            batch, self._pending = self._pending, []
+            f, gen = self._wal_f, self._wal_gen
+        if not batch:
+            return
+        if self._fault_before_sync is not None:
+            self._fault_before_sync([seq for seq, _w in batch])
+        t0 = time.monotonic()
+        err: Optional[BaseException] = None
+        for _attempt in range(2):
+            try:
+                if f is None:
+                    raise OSError("store poisoned (journal failure)")
+                os.fsync(f.fileno())  # conc-ok: the shared group fsync IS the ack point; the sync mutex serializes leaders, appends proceed under the store lock meanwhile
+                err = None
+                break
+            except Exception as e:
+                err = e
+                with self._lock:
+                    if self._wal_gen == gen:
+                        # genuine fsync failure on the live journal:
+                        # memory already shows these txns (visible-
+                        # before-durable) but the disk cannot prove
+                        # them — the acked-write contract is gone.
+                        # Poison the store and fail every waiter (the
+                        # reference asserts out on journal fsync
+                        # failure for the same reason).
+                        self._wal_f = None
+                        self._wal_gen += 1
+                        break
+                    # the fd was swapped under us (another writer's
+                    # append-failure rollback reopened the log); this
+                    # group's records survived the cut — retry the
+                    # fsync on the new fd
+                    f, gen = self._wal_f, self._wal_gen
+        if err is not None:
+            for _seq, w in batch:
+                w.finish(err if isinstance(err, OSError)
+                         else OSError(repr(err)))
+            return
+        _pc.inc("group_commits")
+        _pc.tinc("group_commit_time", time.monotonic() - t0)
+        _pc.hist_add("wal_group_size", len(batch))
+        for _seq, w in batch:
+            w.finish()
 
     def _rollback_wal(self) -> None:
-        """Truncate the log back to ``_wal_bytes`` (the end of the last
-        acked record) after a failed append — the runtime twin of
+        """Truncate the log back to ``_wal_bytes`` (the end of the
+        last good append — group members' not-yet-fsynced records must
+        survive the cut) after a failed append — the runtime twin of
         mount()'s torn-tail cut."""
         try:
             try:
@@ -296,21 +427,34 @@ class WALStore(ObjectStore):
             self._wal_f = open(self._wal_path, "ab")
         except Exception:
             self._wal_f = None  # poisoned: every later op asserts
+        finally:
+            self._wal_gen += 1
 
     # -- checkpointing ------------------------------------------------
     def checkpoint(self) -> None:
-        """Fold the WAL into a durable snapshot and truncate it."""
+        """Fold the WAL into a durable snapshot and truncate it.
+
+        Completes every pending group-commit waiter too: the snapshot
+        holds their (already visible) state, so the rename IS their
+        durability — no separate fsync needed."""
         with self._lock:
+            batch, self._pending = self._pending, []
             self._write_checkpoint(self._seq)
             self._ckpt_seq = self._seq
-            if self._wal_f is not None:
-                self._wal_f.close()
             # crash after the rename but before this truncate replays
-            # records with seq <= ckpt seq; the seq check skips them
-            self._wal_f = open(self._wal_path, "wb")
-            if self._sync:
-                os.fsync(self._wal_f.fileno())  # conc-ok: checkpoint must be atomic vs writers; the lock is the barrier
+            # records with seq <= ckpt seq; the seq check skips them.
+            # Truncate IN PLACE (append-mode writes land at EOF
+            # regardless): the fd must stay valid — a group-commit
+            # leader may be fsyncing it right now, which must not see
+            # the journal yanked out from under it
+            if self._wal_f is not None:
+                self._wal_f.flush()
+                os.ftruncate(self._wal_f.fileno(), 0)
+                if self._sync:
+                    os.fsync(self._wal_f.fileno())  # conc-ok: checkpoint must be atomic vs writers; the lock is the barrier
             self._wal_bytes = 0
+        for _seq, w in batch:
+            w.finish()
 
     def _write_checkpoint(self, seq: int) -> None:
         os.makedirs(self.path, exist_ok=True)

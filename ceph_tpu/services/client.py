@@ -35,6 +35,27 @@ class ObjectNotFound(KeyError):
     TimeoutError/OSError and is retried)."""
 
 
+class AioCompletion:
+    """librados ``rados_completion_t`` analogue: handed out by
+    ``aio_put``/``aio_write``; ``wait()`` re-raises the op's failure
+    on the caller's thread."""
+
+    __slots__ = ("_done", "error")
+
+    def __init__(self):
+        self._done = threading.Event()
+        self.error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        if not self._done.wait(timeout):
+            raise TimeoutError("aio op still in flight")
+        if self.error is not None:
+            raise self.error
+
+
 def object_to_ps(oid: str) -> int:
     """object name -> placement seed.  The reference uses
     ceph_str_hash_rjenkins (object_locator_to_pg); any fixed 32-bit
@@ -62,10 +83,23 @@ class Client(MapFollower):
             self.tracer = Tracer(f"client.{name}")
             self.pc = collection().create(f"client.{name}")
         for key in ("ops_put", "ops_get", "ops_write", "ops_delete",
-                    "op_errors"):
+                    "op_errors", "ops_aio_put", "ops_aio_write"):
             self.pc.add_u64_counter(key)
         self.pc.add_histogram("op_lat")
         self.pc.add_time("op_time")
+        # in-flight window occupancy at each aio submit — proves the
+        # pipeline actually keeps the OSD queues full
+        self.pc.add_histogram("aio_depth", min_value=1)
+        # -- pipelined I/O (the librados aio_* window) ---------------
+        from ..common.throttle import Throttle
+
+        window = (ctx.conf["client_aio_window"] if ctx is not None
+                  else 16)
+        self._aio_window = max(1, int(window))
+        self._aio_throttle = Throttle(f"client-aio-{name}",
+                                      self._aio_window)
+        self._aio_pool = None  # lazy: sync-only clients never pay it
+        self._aio_inflight: set = set()
         self.optracker = OpTracker()
         if ctx is not None and ctx.conf["admin_socket"]:
             sock = ctx.start_admin_socket()
@@ -94,9 +128,103 @@ class Client(MapFollower):
         self._install_map(self.subscribe_all(f"client.{name}"))
 
     def shutdown(self) -> None:
+        with self._lock:
+            pool, self._aio_pool = self._aio_pool, None
+        if pool is not None:
+            # no wait: in-flight aio ops fail fast once the messenger
+            # drops its sockets below; their workers then exit
+            pool.shutdown(wait=False)
         self.msgr.shutdown()
         if self.ctx is not None:
             self.ctx.shutdown()
+
+    # -- pipelined I/O (aio_put/aio_write/flush) -----------------------
+    def aio_put(self, pool_id: int, oid: str, data: bytes,
+                retries: int = 3,
+                on_complete=None) -> AioCompletion:
+        """Async ``put`` with a bounded in-flight window: blocks only
+        while the window (``client_aio_window``, default 16) is full,
+        so callers keep the OSD queues full instead of ping-ponging
+        one op at a time.  Durability/ack semantics are ``put``'s —
+        the completion fires when the primary acked the write.
+        ``on_complete(comp)`` runs on the worker thread right after."""
+        return self._aio_submit("put", on_complete, self.put,
+                                pool_id, oid, bytes(data), retries)
+
+    def aio_write(self, pool_id: int, oid: str, offset: int,
+                  data: bytes, retries: int = 3,
+                  on_complete=None) -> AioCompletion:
+        """Async partial ``write`` under the same in-flight window."""
+        return self._aio_submit("write", on_complete, self.write,
+                                pool_id, oid, offset, bytes(data),
+                                retries)
+
+    def _aio_submit(self, kind: str, on_complete, fn,
+                    *args) -> AioCompletion:
+        self._aio_throttle.get()  # the bounded window (backpressure)
+        comp = AioCompletion()
+        with self._lock:
+            pool = self._aio_pool
+            if pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                pool = self._aio_pool = ThreadPoolExecutor(
+                    max_workers=self._aio_window,
+                    thread_name_prefix=f"aio:{self.name}")
+            self._aio_inflight.add(comp)
+        self.pc.hist_add("aio_depth",
+                         self._aio_throttle.get_current())
+        self.pc.inc(f"ops_aio_{kind}")
+
+        def run():
+            try:
+                fn(*args)
+            except BaseException as e:
+                comp.error = e
+            finally:
+                with self._lock:
+                    self._aio_inflight.discard(comp)
+                self._aio_throttle.put()
+                comp._done.set()
+                if on_complete is not None:
+                    try:
+                        on_complete(comp)
+                    except Exception:
+                        pass  # a callback bug must not kill the pool
+
+        try:
+            pool.submit(run)
+        except RuntimeError:  # racing shutdown
+            with self._lock:
+                self._aio_inflight.discard(comp)
+            self._aio_throttle.put()
+            comp.error = OSError(f"client.{self.name} shut down")
+            comp._done.set()
+        return comp
+
+    def flush(self, timeout: float = 60.0) -> None:
+        """Wait for every outstanding aio op (librados
+        rados_aio_flush): returns once the window is empty; re-raises
+        the FIRST failed op's error after all have settled."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            comps = list(self._aio_inflight)
+        first: Optional[BaseException] = None
+        for c in comps:
+            try:
+                c.wait(max(0.0, deadline - time.monotonic()))
+            except TimeoutError as e:
+                if not c.done():
+                    raise  # the flush window itself expired
+                if first is None:  # the OP failed with TimeoutError
+                    first = e
+            except BaseException as e:
+                if first is None:
+                    first = e
+        self._aio_throttle.wait_until_drained(
+            max(0.0, deadline - time.monotonic()))
+        if first is not None:
+            raise first
 
     # -- op instrumentation (the librados op latency surface) ----------
     @contextlib.contextmanager

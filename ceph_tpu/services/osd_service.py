@@ -108,6 +108,12 @@ class OSDService(MapFollower):
         from ..common.op_tracker import OpTracker
 
         self.optracker = OpTracker()
+        # cross-thread EC encode coalescing: concurrent same-pool
+        # writes share one batched engine dispatch (ec/batcher.py)
+        from ..ec.batcher import EncodeBatcher
+
+        self._ec_batcher = EncodeBatcher(
+            max_delay_us=ctx.conf["ec_encode_batch_max_delay_us"])
         # (cid, oid) -> {watcher name: addr}: the Watch/Notify state
         # (src/osd/Watch.cc role).  In-memory: clients re-watch on map
         # changes, exactly like librados re-watches on reconnect.
@@ -123,8 +129,10 @@ class OSDService(MapFollower):
         self._last_scrub: Dict[Tuple[int, int], float] = {}
         self._scrub_slots = threading.Semaphore(1)
         # dmClock QoS at the store door: client vs recovery vs scrub
-        # ops are served in tag order by a small worker pool
-        self.sched = OpScheduler(n_workers=2)
+        # ops are served in tag order by a small worker pool (4: a
+        # window of pipelined client writes must overlap their
+        # store commits, not serialize two at a time)
+        self.sched = OpScheduler(n_workers=4)
         self.pc = ctx.perf.create(f"osd.{osd_id}")
         for key in ("ops_w", "ops_r", "recovered_objects",
                     "map_epochs"):
@@ -171,7 +179,8 @@ class OSDService(MapFollower):
         from ..os.wal_store import WALStore
 
         path = os.path.join(self.data_dir, f"osd.{self.id}.wal")
-        st = WALStore(path)
+        st = WALStore(path, group_commit_max_delay_us=self.ctx.conf[
+            "wal_group_commit_max_delay_us"])
         if not os.path.exists(os.path.join(path, "checkpoint")):
             st.mkfs()
         st.mount()
@@ -448,7 +457,7 @@ class OSDService(MapFollower):
                 from concurrent.futures import ThreadPoolExecutor
 
                 pool = self._fanout_pool = ThreadPoolExecutor(
-                    max_workers=8,
+                    max_workers=16,
                     thread_name_prefix=f"osd{self.id}-fanout")
             return pool
 
@@ -622,7 +631,10 @@ class OSDService(MapFollower):
             with self.tracer.start_span(
                     "ec.encode", require_parent=True,
                     tags={"bytes": len(buf), "k": k, "m": n - k}):
-                chunks = code.encode(range(n), bytes(buf))
+                # through the coalescer: concurrent writes to other
+                # PGs of this pool share one batched dispatch
+                chunks = self._ec_batcher.encode(code, range(n),
+                                                 bytes(buf))
                 payloads = [np.asarray(chunks[p], np.uint8).tobytes()
                             for p in range(n)]
             # distribute; a `superseded` reply means some holder has a

@@ -126,6 +126,40 @@ class ObjBencher:
         self.written = res.summary().get("ops", 0) + res.errors
         return res
 
+    def write_aio(self, seconds: float) -> BenchResult:
+        """Pipelined write phase: ONE submitter drives ``aio_put``,
+        paced by the client's bounded in-flight window (the rados
+        bench -t queue-depth semantics) so the OSD queues stay full
+        instead of ping-ponging per-thread synchronous ops.  Latency
+        samples are per-op submit→complete, recorded at completion."""
+        blob = bytes(
+            (i * 131 + 17) & 0xFF for i in range(self.object_size))
+        res = BenchResult("write", self.object_size)
+        stop = time.monotonic() + seconds
+        i = 0
+        t0 = time.monotonic()
+        while time.monotonic() < stop:
+            t_op = time.perf_counter()
+
+            def done(c, t=t_op):
+                if c.error is not None:
+                    res.add_error()
+                else:
+                    res.add(time.perf_counter() - t)
+
+            # blocks while the window is full — the submit loop runs
+            # exactly at the client's queue depth
+            self.client.aio_put(self.pool_id, f"{self.prefix}_{i}",
+                                blob, on_complete=done)
+            i += 1
+        try:
+            self.client.flush(timeout=60)
+        except Exception:
+            pass  # per-op errors were already counted by callbacks
+        res.wall = time.monotonic() - t0
+        self.written = i
+        return res
+
     def seq(self, seconds: float) -> BenchResult:
         limit = max(1, self.written)
 
@@ -154,15 +188,26 @@ class ObjBencher:
 def bench_minicluster(op: str = "write", seconds: float = 5.0,
                       concurrent: int = 8, object_size: int = 1 << 16,
                       n_osds: int = 4, ec: bool = False,
-                      pg_num: int = 16) -> Dict:
+                      pg_num: int = 16, qd: Optional[int] = None,
+                      qd_sweep: Optional[List[int]] = None) -> Dict:
     """One-shot: boot a MiniCluster, run write (then optionally a read
-    phase), return the summary dict."""
+    phase), return the summary dict.
+
+    ``qd``: drive the write phase through the pipelined aio path at
+    that queue depth instead of ``concurrent`` synchronous threads.
+    ``qd_sweep``: run one aio write phase per depth and report the
+    best (plus the whole sweep under ``qd_sweep``) — the knee of that
+    curve is the cluster's write pipeline capacity."""
     from ..common.config import Config
     from ..services.cluster import MiniCluster
 
     conf = Config()
     conf.set("osd_heartbeat_interval", 0.5)
     conf.set("osd_heartbeat_grace", 5.0)
+    # the bench measures the data path, not the telemetry plane:
+    # full-rate span recording is real per-op CPU on a saturated host
+    # (the trace_sample_rate knob exists for exactly this call)
+    conf.set("trace_sample_rate", 0.0)
     cluster = MiniCluster(n_osds=n_osds, config=conf).start()
     try:
         if ec:
@@ -174,11 +219,38 @@ def bench_minicluster(op: str = "write", seconds: float = 5.0,
         else:
             cluster.create_replicated_pool(
                 1, pg_num=pg_num, size=min(3, n_osds))
-        cli = cluster.client("bench")
-        b = ObjBencher(cli, 1, object_size=object_size,
-                       concurrent=concurrent)
-        w = b.write(seconds)
-        out = {"write": w.summary()}
+        out: Dict = {}
+        if qd_sweep:
+            sweep: Dict[str, Dict] = {}
+            best = None
+            b = None
+            for depth in qd_sweep:
+                conf.set("client_aio_window", depth)
+                cli = cluster.client(f"bench-qd{depth}")
+                bench = ObjBencher(cli, 1, object_size=object_size,
+                                   concurrent=concurrent)
+                s = bench.write_aio(seconds).summary()
+                s["qd"] = depth
+                sweep[str(depth)] = s
+                if best is None or (s.get("iops") or 0) > \
+                        (best.get("iops") or 0):
+                    best, b = s, bench
+            out["write"] = best
+            out["qd_sweep"] = {d: s.get("iops")
+                               for d, s in sweep.items()}
+        elif qd:
+            conf.set("client_aio_window", qd)
+            cli = cluster.client("bench")
+            b = ObjBencher(cli, 1, object_size=object_size,
+                           concurrent=concurrent)
+            s = b.write_aio(seconds).summary()
+            s["qd"] = qd
+            out["write"] = s
+        else:
+            cli = cluster.client("bench")
+            b = ObjBencher(cli, 1, object_size=object_size,
+                           concurrent=concurrent)
+            out["write"] = b.write(seconds).summary()
         if op in ("seq", "rand"):
             out[op] = getattr(b, op)(seconds).summary()
         out["pool"] = "ec(2,1)" if ec else "replicated(size=" + \
@@ -199,12 +271,20 @@ def main(argv=None) -> int:
     ap.add_argument("--pg-num", type=int, default=16)
     ap.add_argument("--ec", action="store_true",
                     help="bench an EC(2,1) pool instead of replicated")
+    ap.add_argument("--qd", type=int, default=None,
+                    help="drive writes through the pipelined aio "
+                         "path at this queue depth")
+    ap.add_argument("--qd-sweep", type=str, default=None,
+                    help="comma-separated queue depths to sweep "
+                         "(e.g. 8,16,32); reports the best")
     args = ap.parse_args(argv)
 
+    sweep = [int(x) for x in args.qd_sweep.split(",")] \
+        if args.qd_sweep else None
     out = bench_minicluster(
         op=args.op, seconds=args.seconds, concurrent=args.concurrent,
         object_size=args.object_size, n_osds=args.osds, ec=args.ec,
-        pg_num=args.pg_num)
+        pg_num=args.pg_num, qd=args.qd, qd_sweep=sweep)
     for phase, s in out.items():
         if isinstance(s, dict):
             print(f"# {phase}: {s.get('iops')} IOPS, "

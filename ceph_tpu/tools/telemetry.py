@@ -141,6 +141,13 @@ DEFAULT_COLUMNS: List[Tuple[str, str, str]] = [
     ("osd.*", "ops_r", "rd/s"),
     ("client.*", "ops_put", "put/s"),
     ("client.*", "ops_get", "get/s"),
+    # the data-plane batching layers (PR 5): journal txns vs shared
+    # fsyncs (their ratio IS the group-commit win), EC dispatches,
+    # and the pipelined client window
+    ("os.wal", "txns", "waltx/s"),
+    ("os.wal", "group_commits", "fsync/s"),
+    ("ec.engine", "encode_ops", "ecenc/s"),
+    ("client.*", "ops_aio_put", "aput/s"),
     ("mon*", "epochs", "epo/s"),
 ]
 

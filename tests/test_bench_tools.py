@@ -55,3 +55,43 @@ def test_opscheduler_limit_ceiling():
         assert dt >= (n - 1) / 50.0 * 0.8, dt
     finally:
         sched.shutdown()
+
+
+def test_rados_bench_qd_sweep_smoke():
+    """The pipelined aio write path at a queue-depth sweep: each depth
+    reports, the best is promoted, and the sweep rides the summary."""
+    out = bench_minicluster(op="seq", seconds=0.8, concurrent=4,
+                            object_size=4096, n_osds=3, pg_num=8,
+                            qd_sweep=[4, 8])
+    assert set(out["qd_sweep"]) == {"4", "8"}
+    w = out["write"]
+    assert w["qd"] in (4, 8)
+    assert w["ops"] > 0 and w["errors"] == 0
+    assert out["seq"]["ops"] > 0
+
+
+def test_bench_init_probe_fail_fast():
+    """The staged-lane backend-init probe (satellite regression for
+    the BENCH_r05 300 s hang): a worker that never emits its init
+    line must be declared dead at INIT_DEADLINE (60 s default), not
+    at the full worker deadline — checked here with a tiny deadline
+    against a sleeping child."""
+    import subprocess
+    import sys
+
+    import bench
+
+    assert bench.INIT_DEADLINE <= 60.0  # the fail-fast contract
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(30)"],
+        stdout=subprocess.PIPE, text=True)
+    try:
+        stream = bench.Stream(proc, "probe-test")
+        t0 = time.monotonic()
+        got = stream.wait(lambda r: r.get("stage") == "init", 0.5)
+        dt = time.monotonic() - t0
+        assert got is None, "no init line must mean probe failure"
+        assert dt < 5.0, f"probe waited {dt:.1f}s past its deadline"
+    finally:
+        proc.kill()
+        proc.wait()

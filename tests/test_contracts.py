@@ -19,9 +19,10 @@ from ceph_tpu.analysis import jaxcheck
 # must carry a contract — deleting one (or forgetting to register a
 # new kernel's) fails here, not silently
 EXPECTED_CONTRACTS = {
-    "ec.engine.mod2_matmul", "ec.rs_jax", "ec.jerasure", "ec.isa",
-    "ec.lrc", "ec.shec", "ec.clay", "ec.native_gf", "ec.pallas",
-    "crush.mapper_jax", "crush.mapper_spec",
+    "ec.engine.mod2_matmul", "ec.engine.encode_batched", "ec.rs_jax",
+    "ec.jerasure", "ec.isa", "ec.lrc", "ec.shec", "ec.clay",
+    "ec.native_gf", "ec.pallas", "crush.mapper_jax",
+    "crush.mapper_spec",
 }
 
 
