@@ -65,9 +65,66 @@ EC_DEADLINE = float(os.environ.get("CEPH_TPU_BENCH_EC_DEADLINE", 150))
 
 RESULT_TAG = "BENCH_RESULT "
 
+# SLO floors (env-overridable): the throughput a stage must clear for
+# its slo block to record pass=true — what tools/perf_history.py turns
+# into a red check instead of archaeology.  Floors are deliberately
+# below the measured trajectory (r01-r05) so they flag regressions,
+# not noise.
+SLO_FLOORS = {
+    "crush_big10k_mappings_per_sec": float(os.environ.get(
+        "CEPH_TPU_SLO_CRUSH_FLOOR", 80_000)),
+    "ec_encode_gbps": float(os.environ.get(
+        "CEPH_TPU_SLO_EC_ENCODE_FLOOR", 0.3)),
+    "ec_batch_speedup": float(os.environ.get(
+        "CEPH_TPU_SLO_EC_BATCH_FLOOR", 1.5)),
+    "cluster_write_iops": float(os.environ.get(
+        "CEPH_TPU_SLO_CLUSTER_IOPS_FLOOR", 100)),
+}
+
 
 def _emit(**kw):
     print(RESULT_TAG + json.dumps(kw), flush=True)
+
+
+def _slo(metric: str, value, floor_key: str = None, **lat):
+    """One stage's SLO block: value vs floor (+p50/p99 latency when
+    the stage measures per-op latency)."""
+    floor = SLO_FLOORS.get(floor_key or metric)
+    block = {"metric": metric,
+             "value": round(value, 3) if isinstance(
+                 value, float) else value}
+    if floor is not None:
+        block["floor"] = floor
+        block["pass"] = bool(value is not None and value >= floor)
+    block.update({k: v for k, v in lat.items() if v is not None})
+    return block
+
+
+def _lib_counters():
+    """Flattened numeric snapshot of the process-global perf
+    collection ('logger.key': value) — what stage counter deltas
+    diff.  Import is lazy: only workers (which already load the
+    library) pay for it."""
+    from ceph_tpu.common.perf_counters import collection
+
+    out = {}
+    for logger, counters in collection().dump().items():
+        for key, val in counters.items():
+            if isinstance(val, (int, float)):
+                out[f"{logger}.{key}"] = val
+    return out
+
+
+def _counter_deltas(before, after):
+    """Non-zero counter movement during a stage — the device-plane
+    story (kernel launches, transfer bytes, jit compiles) attached to
+    every stage JSON."""
+    out = {}
+    for key, val in after.items():
+        d = val - before.get(key, 0)
+        if d:
+            out[key] = round(d, 6) if isinstance(d, float) else d
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -149,10 +206,15 @@ def _stage_crush(name, plat, batch, iters, engine="xla"):
     res.block_until_ready()
     compile_s = time.perf_counter() - t0
     _golden_check(case, res, lens, f"{plat}/{name}/{engine}")
+    c0 = _lib_counters()
     rate, dt = _measure_crush(fn, A, weight, batch, iters)
     _emit(stage="crush", map=name, rate=rate, platform=plat,
           engine=engine, compile_s=round(compile_s, 2),
-          measure_s=round(dt, 3), batch=batch, iters=iters)
+          measure_s=round(dt, 3), batch=batch, iters=iters,
+          counters=_counter_deltas(c0, _lib_counters()),
+          slo=_slo(f"crush_{name[4:]}_mappings_per_sec", rate,
+                   floor_key="crush_big10k_mappings_per_sec"
+                   if name == "map_big10k" else None))
     return rate
 
 
@@ -247,14 +309,18 @@ def worker_crush_cpu(batch=None, iters=None):
     setup_s = time.perf_counter() - t0
 
     batch, iters = batch or (1 << 16), iters or 4
+    c0 = _lib_counters()
     t0 = time.perf_counter()
     for i in range(iters):
         xs = np.arange(i * batch, (i + 1) * batch, dtype=np.uint32)
         nm.map_batch(case["ruleno"], xs, case["numrep"], weight)
     dt = time.perf_counter() - t0
-    _emit(stage="crush", map="map_big10k", rate=batch * iters / dt,
+    rate = batch * iters / dt
+    _emit(stage="crush", map="map_big10k", rate=rate,
           platform="cpu", engine="native", compile_s=round(setup_s, 2),
-          measure_s=round(dt, 3), batch=batch, iters=iters)
+          measure_s=round(dt, 3), batch=batch, iters=iters,
+          counters=_counter_deltas(c0, _lib_counters()),
+          slo=_slo("crush_big10k_mappings_per_sec", rate))
 
 
 def _stage_ec(plat, k=8, m=3, chunk=1 << 18, batch=4, iters=8,
@@ -289,6 +355,7 @@ def _stage_ec(plat, k=8, m=3, chunk=1 << 18, batch=4, iters=8,
     raw = rng.integers(0, 256, (k, batch * chunk), dtype=np.uint8)
     data = data_of(raw)
 
+    c_pre = _lib_counters()
     t0 = time.perf_counter()
     out = code.encode(data)
     _sync(out)
@@ -315,7 +382,9 @@ def _stage_ec(plat, k=8, m=3, chunk=1 << 18, batch=4, iters=8,
     dec_gbps = (k * batch * chunk * iters) / dt / 1e9
     _emit(stage="ec", tag=tag, encode_gbps=round(enc_gbps, 3),
           decode_gbps=round(dec_gbps, 3), platform=plat, engine=engine,
-          k=k, m=m, chunk=chunk, compile_s=round(compile_s, 2))
+          k=k, m=m, chunk=chunk, compile_s=round(compile_s, 2),
+          counters=_counter_deltas(c_pre, _lib_counters()),
+          slo=_slo("ec_encode_gbps", enc_gbps))
 
 
 def _stage_ec_profiles():
@@ -390,6 +459,7 @@ def _stage_ec_batch(plat, k=4, m=2, n_stripes=64, chunk=1024,
     # warm both shapes (compiles excluded from the measurement)
     sync(bc.encode(dev[0]))
     sync(bc.encode_batched(stripes))
+    c_pre = _lib_counters()
     t0 = time.perf_counter()
     for _ in range(iters):
         for s in dev:
@@ -402,11 +472,14 @@ def _stage_ec_batch(plat, k=4, m=2, n_stripes=64, chunk=1024,
     sync(out)
     batched = time.perf_counter() - t0
     nbytes = n_stripes * k * chunk * iters
+    speedup = per_stripe / batched
     _emit(stage="ec_batch", platform=plat, k=k, m=m,
           n_stripes=n_stripes, chunk=chunk,
           per_stripe_gbps=round(nbytes / per_stripe / 1e9, 3),
           batched_gbps=round(nbytes / batched / 1e9, 3),
-          speedup=round(per_stripe / batched, 2))
+          speedup=round(speedup, 2),
+          counters=_counter_deltas(c_pre, _lib_counters()),
+          slo=_slo("ec_batch_speedup", speedup))
 
 
 def worker_ec_cpu():
@@ -422,6 +495,7 @@ def worker_cluster():
     curve is the write pipeline's capacity) + seq-read IOPS/latency."""
     from ceph_tpu.tools.rados_bench import bench_minicluster
 
+    c_pre = _lib_counters()
     out = bench_minicluster(op="seq", seconds=2.0, concurrent=8,
                             object_size=1 << 16, n_osds=4,
                             qd_sweep=[8, 16, 32])
@@ -434,7 +508,12 @@ def worker_cluster():
           seq_iops=out.get("seq", {}).get("iops"),
           seq_mbps=out.get("seq", {}).get("mb_per_sec"),
           seq_p99_ms=out.get("seq", {}).get("lat_p99_ms"),
-          n_osds=out.get("n_osds"))
+          n_osds=out.get("n_osds"),
+          counters=_counter_deltas(c_pre, _lib_counters()),
+          slo=_slo("cluster_write_iops",
+                   out["write"].get("iops") or 0.0,
+                   p50_ms=out["write"].get("lat_p50_ms"),
+                   p99_ms=out["write"].get("lat_p99_ms")))
 
 
 # ---------------------------------------------------------------------------
@@ -623,6 +702,8 @@ def main():
         "measure_s": headline.get("measure_s"),
         "cpu_rate": round(cpu_res["rate"], 1) if cpu_res else None,
         "cpu_engine": cpu_res.get("engine") if cpu_res else None,
+        "slo": headline.get("slo") or _slo(
+            "crush_big10k_mappings_per_sec", rate),
     }
     if backend_init_failed:
         out["backend_init_failed"] = True
@@ -706,6 +787,12 @@ def main():
               f" IOPS ({cl_res['seq_mbps']} MB/s)", file=sys.stderr)
         print("# cluster json: " + json.dumps(cl_res),
               file=sys.stderr)
+        slo = cl_res.get("slo") or {}
+        if "pass" in slo:
+            print(f"# slo cluster_write_iops: value "
+                  f"{slo.get('value')} floor {slo.get('floor')} -> "
+                  f"{'PASS' if slo['pass'] else 'FAIL'}",
+                  file=sys.stderr)
 
 
 if __name__ == "__main__":

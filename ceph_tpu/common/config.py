@@ -115,6 +115,27 @@ OPTIONS: Dict[str, Option] = _opts(
            "microseconds the OSD's EC encode coalescer waits for more "
            "same-pool writes to join a batched encode dispatch; 0 = "
            "coalesce only what queued during the previous dispatch"),
+    Option("metrics_history_interval", float, 1.0,
+           "seconds between perf-counter samples into each daemon's "
+           "metrics-history ring (common/metrics_history.py, the "
+           "dump_metrics_history surface); 0 disables the sampler"),
+    Option("metrics_history_retention", int, 240,
+           "samples retained per daemon's metrics-history ring "
+           "(newest-wins)"),
+    Option("osd_pg_stat_report_interval", float, 2.0,
+           "seconds between an OSD's periodic pg_stats beacons to the "
+           "monitors (cached PG state + per-pool io/recovery "
+           "counters; the mgr stats-report cadence role)"),
+    Option("mon_pg_stats_stale_grace", float, 15.0,
+           "seconds without a primary pg_stats report before a PG's "
+           "stats are STALE (the STALE_PG_STATS health check); "
+           "entries older than 4x this are aged out entirely"),
+    Option("mon_slow_recovery_grace", float, 60.0,
+           "seconds a recovery progress event may stay open before "
+           "the SLOW_RECOVERY health check fires"),
+    Option("mon_pool_stats_retention", int, 240,
+           "per-pool stat samples retained by the monitor's PGMap "
+           "ring (the `pool-stats` rate series)"),
 )
 
 

@@ -44,6 +44,8 @@ class Context:
                              sample_rate=self.conf["trace_sample_rate"])
         self._admin: Optional[AdminSocket] = None
         self._admin_dir = admin_dir
+        # the daemon's counter time-series ring (dump_metrics_history)
+        self._metrics_history = None
         # (option, callback) pairs to detach on shutdown — contexts may
         # share a Config (MiniCluster revives), so observers must not
         # outlive their runtime
@@ -83,13 +85,31 @@ class Context:
             from ..analysis.watchdog import start_global
 
             start_global(self.conf["watchdog_threshold"])
+            # the continuous plane: sample this runtime's counters
+            # into a bounded ring, served as dump_metrics_history
+            if self.conf["metrics_history_interval"] > 0:
+                from .metrics_history import MetricsHistory
+
+                self._metrics_history = MetricsHistory(
+                    self.name, perf=self.perf,
+                    interval=self.conf["metrics_history_interval"],
+                    retention=self.conf["metrics_history_retention"])
+                self._metrics_history.wire(self._admin)
+                self._metrics_history.start()
         return self._admin
+
+    @property
+    def metrics_history(self):
+        return self._metrics_history
 
     def shutdown(self) -> None:
         for opt, cb in self._observers:
             self.conf.remove_observer(opt, cb)
         self._observers.clear()
         self._observed.clear()
+        if self._metrics_history is not None:
+            self._metrics_history.stop()
+            self._metrics_history = None
         if self._admin is not None:
             self._admin.shutdown()
             self._admin = None

@@ -1,0 +1,140 @@
+"""Central perf-counter registry — the single source of counter names.
+
+The reference declares every counter in one PerfCountersBuilder block
+per daemon (src/osd/OSD.cc:3260 osd_counters, src/mon/Monitor.cc
+mon_counters, ...), so tooling — `ceph daemonperf` column schemas,
+the mgr prometheus module — can rely on names that exist.  This module
+is that declaration surface for the framework: every counter any
+module books (``PerfCounters.inc/dec/set/tinc/avg_add/hist_add``) or
+declares (``add_u64_counter``/``add_histogram``/...) must appear here,
+keyed by logger family.
+
+Enforced statically by ``tools/lint_obs.py`` (rule OBS001, wired into
+``tests/test_lint.py``): an update or declaration with a literal name
+absent from this registry fails CI, so the telemetry/daemonperf column
+definitions can never silently drift from the counters the daemons
+actually book.  ``tests/test_lint.py`` additionally pins the
+``telemetry.DEFAULT_COLUMNS`` keys against this registry.
+
+Logger families are matched by prefix: the ``osd`` family covers
+``osd.0``, ``osd.1``...; ``client`` covers ``client.admin``; ``msgr``
+covers ``msgr.osd.0`` — the instance suffix carries no schema.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet
+
+U64 = "u64"
+GAUGE = "gauge"
+TIME = "time"
+AVG = "avg"
+HIST = "hist"
+
+# {logger family: {counter name: type}} — the declaration mirror.
+REGISTRY: Dict[str, Dict[str, str]] = {
+    "mon": {
+        "epochs": U64,
+        "beats": U64,
+        "markdowns": U64,
+        "commit_lat": HIST,
+        "commit_time": TIME,
+        "pg_stat_reports": U64,
+        "stale_pgs": GAUGE,
+    },
+    "osd": {
+        "ops_w": U64,
+        "ops_r": U64,
+        "recovered_objects": U64,
+        "recovery_bytes": U64,
+        "map_epochs": U64,
+        "pg_stat_beacons": U64,
+    },
+    "client": {
+        "ops_put": U64,
+        "ops_get": U64,
+        "ops_write": U64,
+        "ops_delete": U64,
+        "op_errors": U64,
+        "ops_aio_put": U64,
+        "ops_aio_write": U64,
+        "op_lat": HIST,
+        "op_time": TIME,
+        "aio_depth": HIST,
+    },
+    "msgr": {
+        "bytes_in": U64,
+        "bytes_out": U64,
+        "frames_in": U64,
+        "frames_out": U64,
+        "dispatch_lat": HIST,
+        "dispatch_time": TIME,
+    },
+    "ec.engine": {
+        "encode_ops": U64,
+        "decode_ops": U64,
+        "encode_bytes": U64,
+        "decode_bytes": U64,
+        "jit_compiles": U64,
+        "encode_time": TIME,
+        "decode_time": TIME,
+        "jit_compile_time": TIME,
+        "encode_lat": HIST,
+        "decode_lat": HIST,
+        "ec_batch_size": HIST,
+    },
+    "os.wal": {
+        "txns": U64,
+        "group_commits": U64,
+        "group_commit_time": TIME,
+        "wal_group_size": HIST,
+    },
+    "crush.mapper": {
+        "map_calls": U64,
+        "xs_mapped": U64,
+        "jit_compiles": U64,
+        "map_time": TIME,
+        "jit_compile_time": TIME,
+        "map_lat": HIST,
+    },
+    "crush.scalar": {
+        "pg_lookups": U64,
+        "cache_hits": U64,
+        "map_time": TIME,
+        "map_lat": HIST,
+    },
+    # the device plane (common/device_metrics.py): host<->device
+    # transfer volume, kernel launch accounting, and live-buffer /
+    # device-memory gauges sampled into the metrics-history ring
+    "device": {
+        "h2d_bytes": U64,
+        "d2h_bytes": U64,
+        "kernel_launches": U64,
+        "kernel_time": TIME,
+        "live_buffers": GAUGE,
+        "live_buffer_bytes": GAUGE,
+        "live_buffer_bytes_hw": GAUGE,
+    },
+}
+
+
+def all_names() -> FrozenSet[str]:
+    """Every declared counter name, across all families (what OBS001
+    checks literal update/declare sites against)."""
+    out = set()
+    for fam in REGISTRY.values():
+        out.update(fam)
+    return frozenset(out)
+
+
+def family_of(logger: str) -> str:
+    """Registry family for a concrete logger instance name
+    (``osd.3`` -> ``osd``, ``msgr.mon`` -> ``msgr``)."""
+    candidates = [f for f in REGISTRY
+                  if logger == f or logger.startswith(f + ".")]
+    return max(candidates, key=len) if candidates else ""
+
+
+def declared(logger: str, key: str) -> bool:
+    fam = family_of(logger)
+    return bool(fam) and key in REGISTRY[fam]

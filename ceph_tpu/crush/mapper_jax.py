@@ -53,6 +53,7 @@ from jax import lax  # noqa: E402
 
 from . import constants as C  # noqa: E402
 from . import hash as H  # noqa: E402
+from ..common import device_metrics  # noqa: E402
 from ..common.perf_counters import collection  # noqa: E402
 from .ln import (LL_NP, RH_LH_NP, ln16_table, recip64,  # noqa: E402
                  straw2_draw, straw2_key)
@@ -871,4 +872,10 @@ class BatchedMapper:
         else:
             _pc.tinc("map_time", dt)
             _pc.hist_add("map_lat", dt)
+        # device plane: xs + weight cross host->device, the result
+        # block (results + lens, i32) crosses back when consumed
+        device_metrics.record_launch(
+            "crush.mapper", sig, dt,
+            h2d_bytes=int(xs.size) * 4 + int(weight.size) * 4,
+            d2h_bytes=int(xs.shape[0]) * (result_max + 1) * 4)
         return out

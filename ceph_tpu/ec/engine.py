@@ -35,6 +35,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..common import device_metrics
 from ..common.perf_counters import collection
 from .gfw import gf2_mat_inv
 
@@ -71,10 +72,13 @@ def book_batch(n_stripes: int) -> None:
 
 
 def _account(kind: str, sig: tuple, dt: float, nbytes: int,
-             jitted: bool = True) -> None:
+             jitted: bool = True, nbytes_out: int = 0) -> None:
     """Shared by every EC execution engine (the jitted bit-plane path
     here and native_gf's table engine, which passes jitted=False —
-    it has no compile step to separate out)."""
+    it has no compile step to separate out).  Jitted launches also
+    book into the device plane: the input bytes cross host->device,
+    the materialized output crosses back (common/device_metrics.py,
+    per-shape-signature)."""
     _pc.inc(f"{kind}_ops")
     _pc.inc(f"{kind}_bytes", nbytes)
     if jitted and sig not in _seen_sigs:
@@ -84,6 +88,10 @@ def _account(kind: str, sig: tuple, dt: float, nbytes: int,
     else:
         _pc.tinc(f"{kind}_time", dt)
         _pc.hist_add(f"{kind}_lat", dt)
+    if jitted:
+        device_metrics.record_launch(
+            "ec.engine", f"{kind}:{sig}", dt,
+            h2d_bytes=nbytes, d2h_bytes=nbytes_out)
 
 
 @jax.jit
@@ -225,7 +233,8 @@ class BitCode:
                  ("enc", self.coding_bm.shape, tuple(data.shape),
                   self.layout.w, self.layout.packetsize,
                   pk is not None),
-                 time.monotonic() - t0, int(data.size))
+                 time.monotonic() - t0, int(data.size),
+                 nbytes_out=self.m * int(data.shape[1]))
         return out
 
     def encode_batched(self, stripes):
@@ -259,7 +268,8 @@ class BitCode:
                  ("encb", self.coding_bm.shape, (B, k, L),
                   self.layout.w, self.layout.packetsize,
                   pk is not None),
-                 time.monotonic() - t0, int(stripes.size))
+                 time.monotonic() - t0, int(stripes.size),
+                 nbytes_out=B * self.m * L)
         book_batch(B)
         return out
 
@@ -306,7 +316,8 @@ class BitCode:
                  ("dec", inv.shape, tuple(stack.shape),
                   self.layout.w, self.layout.packetsize,
                   pk is not None),
-                 time.monotonic() - t0, int(stack.size))
+                 time.monotonic() - t0, int(stack.size),
+                 nbytes_out=self.k * int(L))
         return out
 
     def decode(self, want: Sequence[int], chunks: Dict[int, "jnp.ndarray"]):

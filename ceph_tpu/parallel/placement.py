@@ -76,3 +76,20 @@ def sharded_rule_fn(cmap: CrushMap, ruleno: int, result_max: int,
         in_shardings=(repl, repl, shard),
         out_shardings=out_sh)
     return sharded, static, arrays
+
+
+def mesh_device_report(mesh: Mesh):
+    """Per-device breakdown for the multichip lane's telemetry: one
+    row per mesh device (id, platform, backend memory stats where the
+    PJRT client exposes them) — the observability ROADMAP item 1's
+    near-linear-scaling claim will be judged against.  Safe here: the
+    caller already owns an initialized mesh, so no backend-init risk."""
+    from ..common import device_metrics
+
+    by_id = {d["id"]: d for d in device_metrics.per_device()}
+    out = []
+    for d in np.asarray(mesh.devices).ravel():  # jax-ok: mesh.devices is a host-side numpy array of Device handles, not device data
+        rec = by_id.get(int(d.id), {"id": int(d.id),
+                                    "platform": str(d.platform)})
+        out.append(rec)
+    return out

@@ -263,6 +263,18 @@ class MiniCluster:
         """`ceph health` surface: HEALTH_OK/HEALTH_WARN + checks."""
         return self.mon_command({"type": "health"})
 
+    def pool_stats(self, pool_id: Optional[int] = None) -> Dict:
+        """Per-pool io/recovery rate series (the PGMap `pool-stats`
+        surface)."""
+        msg: Dict = {"type": "pool_stats"}
+        if pool_id is not None:
+            msg["pool"] = pool_id
+        return self.mon_command(msg)
+
+    def progress(self) -> Dict:
+        """Open + completed recovery events (mgr progress role)."""
+        return self.mon_command({"type": "progress"})
+
     def wait_for_health_ok(self, timeout: float = 30.0) -> Dict:
         deadline = time.monotonic() + timeout
         last = None

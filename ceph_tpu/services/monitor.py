@@ -20,12 +20,13 @@ failure detection.  (SURVEY §2.5 Monitor row.)
 
 from __future__ import annotations
 
+import collections
 import json
 import os
 import queue
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 from ..analysis.lockdep import make_lock, make_rlock
 from ..common import encoding
@@ -95,6 +96,8 @@ class Monitor:
         self.pc.add_u64_counter("epochs")
         self.pc.add_u64_counter("beats")
         self.pc.add_u64_counter("markdowns")
+        self.pc.add_u64_counter("pg_stat_reports")
+        self.pc.add_u64("stale_pgs")
         self.pc.add_histogram("commit_lat")
         self.pc.add_time("commit_time")
         # write commands register here (the leader-side op surface);
@@ -128,6 +131,8 @@ class Monitor:
                           ("ec_profile_set",
                            self._fwd(self._h_ec_profile_set), False),
                           ("pg_stats", self._h_pg_stats, False),
+                          ("pool_stats", self._h_pool_stats, False),
+                          ("progress", self._h_progress, False),
                           ("health", self._h_health, False),
                           ("status", self._h_status, False)):
             self.msgr.register(t, h, control=ctl)
@@ -137,6 +142,18 @@ class Monitor:
         # OSDs broadcast stats to every member, so any mon can serve
         # health without quorum traffic
         self._pg_stats: Dict[Tuple[int, int], Dict] = {}
+        # ((pool, ps), reporter osd) -> {"io": cumulative block,
+        # "last_report": mono}: any shard HOLDER reports io (EC reads
+        # land on every member), so pool sums cover the whole set
+        self._pg_io: Dict[Tuple[Tuple[int, int], int], Dict] = {}
+        # per-pool stat-sample ring (the PGMap delta ring the
+        # `pool-stats` rate series derives from) + the mgr-progress
+        # event surface (open per pool, completed bounded)
+        self._pool_stat_ring: Dict[int, Deque[Dict]] = {}
+        self._progress_open: Dict[int, Dict] = {}
+        self._progress_done: Deque[Dict] = collections.deque(
+            maxlen=32)
+        self._progress_seq = 0
 
     # -- quorum ---------------------------------------------------------
     def set_peers(self, rank: int, addrs: List[Addr]) -> None:
@@ -353,6 +370,13 @@ class Monitor:
             for pgid in [g for g in self._pg_stats
                          if g[0] not in self.map.pools]:
                 del self._pg_stats[pgid]
+            for key in [k for k in self._pg_io
+                        if k[0][0] not in self.map.pools]:
+                del self._pg_io[key]
+            for pid in [p for p in self._pool_stat_ring
+                        if p not in self.map.pools]:
+                del self._pool_stat_ring[pid]
+                self._progress_open.pop(pid, None)
             if self.store_dir:
                 os.makedirs(self.store_dir, exist_ok=True)
                 with open(os.path.join(
@@ -592,9 +616,26 @@ class Monitor:
             self.ec_profiles[msg["name"]] = dict(msg["profile"])
         return {"epoch": self._commit(f"ec profile {msg['name']}")}
 
+    _IO_KEYS = ("rd_ops", "rd_bytes", "wr_ops", "wr_bytes",
+                "ec_encode_ops", "ec_encode_bytes")
+
     def _h_pg_stats(self, msg: Dict) -> None:
+        """One pg_stats beacon.  Io blocks are recorded per reporting
+        OSD (EC reads land on every holder, not the primary); PG
+        state/recovery only from primary beacons, which also refresh
+        the per-PG staleness clock (the STALE_PG_STATS input)."""
         pgid = (int(msg["pool"]), int(msg["ps"]))
+        now = time.monotonic()
+        self.pc.inc("pg_stat_reports")
+        reporter = int(msg.get("osd", msg.get("primary", -1)))
         with self._lock:
+            if isinstance(msg.get("io"), dict):
+                self._pg_io[(pgid, reporter)] = {
+                    "io": {k: float(msg["io"].get(k, 0))
+                           for k in self._IO_KEYS},
+                    "last_report": now}
+            if msg.get("io_only"):
+                return None
             cur = self._pg_stats.get(pgid)
             if cur is None or int(msg.get("epoch", 0)) >= \
                     int(cur.get("epoch", 0)):
@@ -602,41 +643,261 @@ class Monitor:
                     "state": msg.get("state", "unknown"),
                     "objects": int(msg.get("objects", 0)),
                     "primary": int(msg.get("primary", -1)),
-                    "epoch": int(msg.get("epoch", 0))}
+                    "epoch": int(msg.get("epoch", 0)),
+                    "degraded_objects": int(
+                        msg.get("degraded_objects", 0)),
+                    "recovery": {
+                        k: float((msg.get("recovery") or {})
+                                 .get(k, 0))
+                        for k in ("objects_recovered",
+                                  "bytes_recovered")},
+                    "last_report": now}
+                # progress events open ON RECEIPT of a degraded
+                # report, not on the sampling tick: a small recovery
+                # can complete inside one tick interval, and the
+                # event must still exist to complete at 1.0
+                if "degraded" in msg.get("state", ""):
+                    self._open_progress(pgid[0], time.time())
         return None
+
+    def _open_progress(self, pool_id: int, wall: float) -> None:
+        """Open (or bump the peak of) the pool's recovery event
+        (call under self._lock)."""
+        cur = sum(1 for g, st in self._pg_stats.items()
+                  if g[0] == pool_id
+                  and "degraded" in st.get("state", ""))
+        ev = self._progress_open.get(pool_id)
+        if ev is None:
+            self._progress_seq += 1
+            ev = {"id": f"recovery-{pool_id}-{self._progress_seq}",
+                  "pool": pool_id,
+                  "message": f"Recovery: pool {pool_id}",
+                  "started_at": wall, "updated_at": wall,
+                  "peak_degraded_pgs": max(1, cur),
+                  "degraded_pgs": cur,
+                  "fraction": 0.0, "rate_bps": 0.0, "done": False}
+            self._progress_open[pool_id] = ev
+            self.log.dout(1, f"progress: {ev['id']} started "
+                             f"({cur} pgs degraded)")
+        else:
+            ev["peak_degraded_pgs"] = max(ev["peak_degraded_pgs"],
+                                          cur)
+            ev["degraded_pgs"] = cur
+            ev["updated_at"] = wall
 
     def _pg_summary(self) -> Dict:
         """PGMap aggregation (call under self._lock)."""
         by_state: Dict[str, int] = {}
         objects = 0
+        degraded_pgs = 0
         for st in self._pg_stats.values():
             by_state[st["state"]] = by_state.get(st["state"], 0) + 1
             objects += st["objects"]
+            if "degraded" in st["state"]:
+                degraded_pgs += 1
         total = sum(p.pg_num for p in self.map.pools.values())
         return {"pgs_total": total,
                 "pgs_reported": len(self._pg_stats),
-                "by_state": by_state, "objects": objects}
+                "by_state": by_state, "objects": objects,
+                "degraded_pgs": degraded_pgs}
+
+    # -- the continuous stats plane (PGMap ring / mgr progress) --------
+    def _observability_tick(self, now: float) -> None:
+        """Every monitor tick (leader or peon — this is local
+        observability state, not replicated): fold the per-PG reports
+        into per-pool stat samples, drive recovery progress events,
+        and age out stale pg_stats entries."""
+        grace = self.ctx.conf["mon_pg_stats_stale_grace"]
+        retention = self.ctx.conf["mon_pool_stats_retention"]
+        wall = time.time()
+        with self._lock:
+            # age out entries no primary has refreshed (a PG whose
+            # every holder died must not poison health forever);
+            # STALE is the intermediate, surfaced state
+            expiry = 4 * grace
+            stale = 0
+            for pgid in list(self._pg_stats):
+                age = now - self._pg_stats[pgid].get("last_report",
+                                                    now)
+                if age > expiry:
+                    del self._pg_stats[pgid]
+                elif age > grace:
+                    stale += 1
+            self.pc.set("stale_pgs", stale)
+            for key in list(self._pg_io):
+                if now - self._pg_io[key].get("last_report", now) \
+                        > expiry:
+                    del self._pg_io[key]
+            for pool_id in self.map.pools:
+                sample = {"ts": wall}
+                for k in self._IO_KEYS:
+                    sample[k] = sum(
+                        rec["io"].get(k, 0)
+                        for (pgid, _o), rec in self._pg_io.items()
+                        if pgid[0] == pool_id)
+                sample["objects_recovered"] = 0.0
+                sample["bytes_recovered"] = 0.0
+                sample["degraded_objects"] = 0
+                sample["degraded_pgs"] = 0
+                sample["objects"] = 0
+                for pgid, st in self._pg_stats.items():
+                    if pgid[0] != pool_id:
+                        continue
+                    rec = st.get("recovery") or {}
+                    sample["objects_recovered"] += rec.get(
+                        "objects_recovered", 0)
+                    sample["bytes_recovered"] += rec.get(
+                        "bytes_recovered", 0)
+                    sample["degraded_objects"] += st.get(
+                        "degraded_objects", 0)
+                    sample["objects"] += st.get("objects", 0)
+                    if "degraded" in st.get("state", ""):
+                        sample["degraded_pgs"] += 1
+                ring = self._pool_stat_ring.get(pool_id)
+                if ring is None or ring.maxlen != retention:
+                    ring = collections.deque(
+                        ring or (), maxlen=max(2, int(retention)))
+                    self._pool_stat_ring[pool_id] = ring
+                ring.append(sample)
+                self._update_progress(pool_id, sample, wall)
+
+    def _update_progress(self, pool_id: int, sample: Dict,
+                         wall: float) -> None:
+        """mgr progress-module role (call under self._lock): a pool
+        entering degraded state opens a recovery event; completion
+        fraction tracks degraded PGs recovered vs the peak; the event
+        completes at fraction 1.0 when the pool is clean again."""
+        cur = sample["degraded_pgs"]
+        ev = self._progress_open.get(pool_id)
+        if ev is None:
+            if cur > 0:
+                self._open_progress(pool_id, wall)
+            return
+        ev["peak_degraded_pgs"] = max(ev["peak_degraded_pgs"], cur)
+        ev["degraded_pgs"] = cur
+        ev["updated_at"] = wall
+        ring = self._pool_stat_ring.get(pool_id)
+        if ring is not None and len(ring) >= 2:
+            a, b = ring[-2], ring[-1]
+            dt = max(1e-9, b["ts"] - a["ts"])
+            ev["rate_bps"] = max(0.0, (b["bytes_recovered"]
+                                       - a["bytes_recovered"]) / dt)
+        if cur <= 0:
+            ev["fraction"] = 1.0
+            ev["done"] = True
+            ev["ended_at"] = wall
+            self._progress_done.append(ev)
+            del self._progress_open[pool_id]
+            self.log.dout(1, f"progress: {ev['id']} complete")
+        else:
+            ev["fraction"] = round(
+                1.0 - cur / max(1, ev["peak_degraded_pgs"]), 4)
+
+    def _h_pool_stats(self, msg: Dict) -> Dict:
+        """`ceph_cli pool-stats`: per-pool rate SERIES derived from
+        the sample ring at read time (deltas clamped at 0: a primary
+        change resets cumulative counters)."""
+        want = msg.get("pool")
+        with self._lock:
+            rings = {pid: list(ring) for pid, ring in
+                     self._pool_stat_ring.items()
+                     if want is None or pid == int(want)}
+        pools: Dict[str, Dict] = {}
+        rate_keys = (("wr_bps", "wr_bytes"), ("rd_bps", "rd_bytes"),
+                     ("wr_ops_s", "wr_ops"), ("rd_ops_s", "rd_ops"),
+                     ("ec_encode_bps", "ec_encode_bytes"),
+                     ("recovery_bps", "bytes_recovered"),
+                     ("recovery_objs_s", "objects_recovered"))
+        for pid, samples in rings.items():
+            series = []
+            for a, b in zip(samples, samples[1:]):
+                dt = max(1e-9, b["ts"] - a["ts"])
+                row = {"ts": b["ts"], "dt": round(dt, 3),
+                       "degraded_pgs": b["degraded_pgs"],
+                       "degraded_objects": b["degraded_objects"]}
+                for out_k, in_k in rate_keys:
+                    row[out_k] = max(0.0, (b.get(in_k, 0)
+                                           - a.get(in_k, 0)) / dt)
+                series.append(row)
+            pools[str(pid)] = {
+                "series": series,
+                "current": dict(samples[-1]) if samples else {}}
+        return {"pools": pools}
+
+    def _h_progress(self, _msg: Dict) -> Dict:
+        """`ceph_cli progress`: open + recently completed recovery
+        events (the mgr progress-module surface)."""
+        with self._lock:
+            events = [dict(e) for e in
+                      self._progress_open.values()]
+            events += [dict(e) for e in self._progress_done]
+        events.sort(key=lambda e: e.get("started_at", 0))
+        return {"events": events}
 
     def _h_health(self, _msg: Dict) -> Dict:
-        """HEALTH_OK / HEALTH_WARN with reasons — the `ceph health`
-        surface (src/mon/HealthMonitor.cc role)."""
+        """HEALTH_OK / HEALTH_WARN with typed, coded reasons — the
+        `ceph health` surface (src/mon/HealthMonitor.cc role).  Each
+        check is "CODE: summary"; the machine-readable code list rides
+        alongside as ``check_codes``."""
+        now = time.monotonic()
+        grace = self.ctx.conf["mon_pg_stats_stale_grace"]
+        slow_grace = self.ctx.conf["mon_slow_recovery_grace"]
         with self._lock:
+            # down-AND-IN osds (the reference's OSD_DOWN scope): an
+            # osd the cluster already marked out has been remapped
+            # around — it no longer degrades service, so it must not
+            # pin health at WARN after recovery completes
             down = [o for o in range(self.map.max_osd)
-                    if self.map.exists(o) and not self.map.is_up(o)]
+                    if self.map.exists(o) and not self.map.is_up(o)
+                    and self.map.osd_weight[o] > 0]
             pgs = self._pg_summary()
+            stale = [pgid for pgid, st in self._pg_stats.items()
+                     if now - st.get("last_report", now) > grace]
+            recovering = [dict(e) for e in
+                          self._progress_open.values()]
+            slow = [e for e in recovering
+                    if time.time() - e.get("started_at", 0)
+                    > slow_grace]
         checks = []
         if down:
-            checks.append(f"{len(down)} osds down: {down}")
+            checks.append(f"OSD_DOWN: {len(down)} osds down: {down}")
+        if pgs["degraded_pgs"] or recovering:
+            # an OPEN recovery event counts: a fast recovery's
+            # degraded beacons may be superseded between two health
+            # polls, but the cluster WAS degraded until the event
+            # completes (mirrors the reference, where PG_DEGRADED
+            # clears only when recovery finishes)
+            n = max(pgs["degraded_pgs"],
+                    max((e["degraded_pgs"] for e in recovering),
+                        default=0), 1)
+            checks.append(f"PG_DEGRADED: {n} pgs degraded "
+                          f"(recovery in progress)")
         not_clean = {s: n for s, n in pgs["by_state"].items()
                      if "clean" not in s}
         if not_clean:
             checks.append(f"pgs not clean: {not_clean}")
+        if stale:
+            checks.append(
+                f"STALE_PG_STATS: {len(stale)} pgs have had no "
+                f"primary report for >{grace:.0f}s: "
+                f"{sorted(stale)[:8]}")
+        for ev in slow:
+            age = time.time() - ev["started_at"]
+            checks.append(
+                f"SLOW_RECOVERY: {ev['id']} open {age:.0f}s at "
+                f"fraction {ev['fraction']} "
+                f"({ev['rate_bps']:.0f} B/s)")
         if pgs["pgs_reported"] < pgs["pgs_total"]:
             checks.append(
                 f"{pgs['pgs_total'] - pgs['pgs_reported']} pgs never "
                 f"reported by a primary")
         return {"status": "HEALTH_OK" if not checks else "HEALTH_WARN",
-                "checks": checks, "pgmap": pgs}
+                "checks": checks,
+                "check_codes": sorted({c.split(":", 1)[0]
+                                       for c in checks if ":" in c
+                                       and c.split(":", 1)[0].isupper()
+                                       }),
+                "pgmap": pgs}
 
     def _h_status(self, _msg: Dict) -> Dict:
         with self._lock:
@@ -667,6 +928,12 @@ class Monitor:
         out_interval = self.ctx.conf["mon_osd_down_out_interval"]
         while self._running:
             time.sleep(interval / 2)
+            # the stats plane ticks on EVERY member (observability is
+            # local state; any mon serves pool-stats/progress/health)
+            try:
+                self._observability_tick(time.monotonic())
+            except Exception as e:
+                self.log.derr(f"observability tick failed: {e}")
             if self.quorum is not None and not self.quorum.is_leader():
                 continue  # failure detection is the leader's job
             now = time.monotonic()

@@ -135,8 +135,19 @@ class OSDService(MapFollower):
         self.sched = OpScheduler(n_workers=4)
         self.pc = ctx.perf.create(f"osd.{osd_id}")
         for key in ("ops_w", "ops_r", "recovered_objects",
-                    "map_epochs"):
+                    "recovery_bytes", "map_epochs",
+                    "pg_stat_beacons"):
             self.pc.add_u64_counter(key)
+        # per-PG cumulative io/recovery counters (the pg_stat_t
+        # io/recovery sums): client read/write ops+bytes, EC encode
+        # volume, recovery pushes — piggybacked on pg_stats beacons
+        # for the monitor's PGMap per-pool aggregation
+        self._pg_io: Dict[Tuple[int, int], Dict[str, float]] = {}
+        self._pg_io_lock = make_lock("osd::pg_io")
+        # (pool, ps) -> last peering verdict this PRIMARY computed
+        # (state string, object/degraded counts): what the periodic
+        # beacons re-send between peering passes
+        self._pg_states: Dict[Tuple[int, int], Dict] = {}
 
         # map pushes and peering probes ride the control lane: a burst
         # of 16 queued shard writes holds every op-pool worker in the
@@ -268,6 +279,73 @@ class OSDService(MapFollower):
         return cls if cls in ("client", "recovery", "scrub") \
             else "client"
 
+    # -- per-PG io/recovery accounting (pg_stat_t sums role) -----------
+    _IO_KEYS = ("rd_ops", "rd_bytes", "wr_ops", "wr_bytes",
+                "ec_encode_ops", "ec_encode_bytes")
+    _RECOVERY_KEYS = ("objects_recovered", "bytes_recovered")
+
+    def _account_io(self, pool_id: int, ps: int, **deltas) -> None:
+        with self._pg_io_lock:
+            rec = self._pg_io.setdefault(
+                (pool_id, ps),
+                {k: 0 for k in self._IO_KEYS + self._RECOVERY_KEYS})
+            for k, v in deltas.items():
+                rec[k] = rec.get(k, 0) + v
+
+    def _send_pg_stats(self, pool_id: int, ps: int) -> None:
+        """One pg_stats beacon: cached peering state (when this OSD is
+        the PG's primary) + cumulative io/recovery counters.  Any
+        shard holder reports io (EC reads land on every member, not
+        the primary); only primary beacons carry state, so the
+        monitor's staleness clock tracks primaries."""
+        key = (pool_id, ps)
+        with self._pg_io_lock:
+            io = dict(self._pg_io.get(key) or {})
+        with self._lock:
+            state = self._pg_states.get(key)
+        msg: Dict = {"type": "pg_stats", "pool": pool_id, "ps": ps,
+                     "osd": self.id, "epoch": self.epoch,
+                     "io": {k: io.get(k, 0) for k in self._IO_KEYS}}
+        if state is not None:
+            msg.update({"state": state["state"],
+                        "objects": state["objects"],
+                        "primary": self.id,
+                        "degraded_objects": state["degraded_objects"],
+                        "recovery": {k: io.get(k, 0)
+                                     for k in self._RECOVERY_KEYS}})
+        else:
+            msg["io_only"] = True
+        self.mon_send(msg)
+        self.pc.inc("pg_stat_beacons")
+
+    def _stat_beacon_pass(self) -> None:
+        """Periodic pg_stats beacons (the mgr stats-report cadence):
+        re-send every PG this OSD has state or io for, dropping state
+        cache entries for PGs it no longer leads."""
+        with self._pg_io_lock:
+            keys = set(self._pg_io)
+        with self._lock:
+            keys |= set(self._pg_states)
+            m = self.map
+        for pool_id, ps in sorted(keys):
+            if m is not None and pool_id not in m.pools:
+                # the pool is gone: its counters go with it (a stale
+                # key must not abort every later beacon pass)
+                with self._pg_io_lock:
+                    self._pg_io.pop((pool_id, ps), None)
+                with self._lock:
+                    self._pg_states.pop((pool_id, ps), None)
+                continue
+            if m is not None and (pool_id, ps) in self._pg_states:
+                up, _p, acting, _ap = self.pg_up_acting(pool_id, ps)
+                members = acting if acting else up
+                prim = next((o for o in members if self._alive(o)),
+                            None)
+                if prim != self.id:
+                    with self._lock:
+                        self._pg_states.pop((pool_id, ps), None)
+            self._send_pg_stats(pool_id, ps)
+
     def _h_shard_write(self, msg: Dict) -> Dict:
         return self.sched.submit(self._qos_class(msg),
                                  lambda: self._do_shard_write(msg))
@@ -354,6 +432,9 @@ class OSDService(MapFollower):
             size = self.store.getattr(cid, oid, "size") or b"0"
             ver = self.store.getattr(cid, oid, "v") or b""
             self.pc.inc("ops_r")
+            if self._qos_class(msg) == "client":
+                self._account_io(int(msg["pool"]), int(msg["ps"]),
+                                 rd_ops=1, rd_bytes=len(data))
             return {"data": bytes(data), "size": int(size),
                     "v": ver.decode()}
 
@@ -555,6 +636,8 @@ class OSDService(MapFollower):
                                  f"{pool.min_size} required replicas "
                                  f"persisted"}
             self.pc.inc("ops_w")
+            self._account_io(pool_id, ps, wr_ops=1,
+                             wr_bytes=len(data))
             return {"ok": True, "v": v,
                     "degraded": landed < pool.size}
 
@@ -667,6 +750,10 @@ class OSDService(MapFollower):
                 return {"error": f"only {landed} of {k} required "
                                  f"shards persisted"}
             self.pc.inc("ops_w")
+            self._account_io(
+                pool_id, ps, wr_ops=1, wr_bytes=len(buf),
+                ec_encode_ops=1,
+                ec_encode_bytes=sum(len(p) for p in payloads))
             return {"ok": True, "v": v, "size": size,
                     "degraded": landed < n}
 
@@ -1024,10 +1111,22 @@ class OSDService(MapFollower):
     # -- heartbeats ----------------------------------------------------
     def _beat_loop(self) -> None:
         interval = self.ctx.conf["osd_heartbeat_interval"]
+        stat_interval = self.ctx.conf["osd_pg_stat_report_interval"]
+        last_stats = 0.0
         while self._running:
             # mon_send reaches every quorum member: peons forward to
             # the leader, so liveness survives any single monitor death
             self.mon_send({"type": "heartbeat", "osd": self.id})
+            # the continuous-stats cadence rides the beat thread: PG
+            # io/recovery counters reach the monitors between peering
+            # passes, so pool rates resolve at beacon granularity
+            if stat_interval > 0 and \
+                    time.monotonic() - last_stats >= stat_interval:
+                last_stats = time.monotonic()
+                try:
+                    self._stat_beacon_pass()
+                except Exception as e:
+                    self.log.dout(5, f"stat beacon pass failed: {e}")
             time.sleep(interval)
 
     # -- recovery (mark-down -> remap -> recover) ----------------------
@@ -1230,10 +1329,39 @@ class OSDService(MapFollower):
                     merged[oid] = dict(rec)
         my = infos.get(self.id, {}).get("objects", {})
 
+        # the degraded state must be VISIBLE, not just transited: a
+        # small recovery completes within one pass, and only reporting
+        # the end-of-pass verdict would hide the whole
+        # degraded->recovering->clean arc from the PGMap/progress
+        # plane.  Estimate the pre-pass deficit and beacon it before
+        # any recovery work (the estimate may count a torn write the
+        # pass then rolls back — transient, corrected by the final
+        # beacon below).
+        pre_degraded = 0
+        for oid, rec in merged.items():
+            if rec.get("deleted"):
+                continue
+            positions = enumerate(up) if code is not None \
+                else [(0, o) for o in up]
+            if any(self._shard_v_of(infos, o, oid, pos) != rec["v"]
+                   for pos, o in positions):
+                pre_degraded += 1
+        if pre_degraded:
+            n_live = len([o for o in up if self._alive(o)])
+            pre_states = ["active"]
+            if n_live < len(up):
+                pre_states.append("undersized")
+            pre_states += ["degraded", "recovering"]
+            with self._lock:
+                self._pg_states[(pool_id, ps)] = {
+                    "state": "+".join(pre_states),
+                    "objects": len([1 for r in merged.values()
+                                    if not r.get("deleted")]),
+                    "degraded_objects": pre_degraded}
+            self._send_pg_stats(pool_id, ps)
+
         def shard_v(osd: int, oid: str, pos: int) -> str:
-            return infos.get(osd, {}).get("objects", {}) \
-                .get(oid, {}).get("shards", {}) \
-                .get(str(pos), NULL_VERSION)
+            return self._shard_v_of(infos, osd, oid, pos)
 
         # serving continuity: if this (new) primary is missing data,
         # point the PG at the best-covered holder via pg_temp while we
@@ -1260,6 +1388,7 @@ class OSDService(MapFollower):
                 self._set_pg_temp(pool_id, ps, acting_set)
 
         clean = True
+        degraded_objs = 0  # objects needing recovery work this pass
         ec_groups: Dict[Tuple, List[Tuple[str, Dict]]] = {}
         for oid, rec in merged.items():
             if code is not None:
@@ -1307,6 +1436,7 @@ class OSDService(MapFollower):
                 if best_write is None:
                     if cover:
                         clean = False
+                        degraded_objs += 1
                         self.log.derr(
                             f"pg {cid} {oid}: no recoverable "
                             f"version (coverage "
@@ -1317,6 +1447,7 @@ class OSDService(MapFollower):
                     if shard_v(o, oid, pos) != best_write))
                 if not need:
                     continue
+                degraded_objs += 1
                 avail = tuple(sorted(cover[best_write]))
                 rec = dict(rec, v=best_write)
                 ec_groups.setdefault((need, avail, best_write),
@@ -1332,6 +1463,8 @@ class OSDService(MapFollower):
                         self._send_delete(pool_id, ps, o, oid,
                                           rec["v"])
                 continue
+            if any(shard_v(o, oid, 0) != rec["v"] for o in up):
+                degraded_objs += 1
             if not self.backfill_throttle.get(timeout=5):
                 return
             try:
@@ -1361,10 +1494,11 @@ class OSDService(MapFollower):
             states.append("clean")
         n_objects = len([1 for _oid, rec in merged.items()
                          if not rec.get("deleted")])
-        self.mon_send({"type": "pg_stats", "pool": pool_id, "ps": ps,
-                       "state": "+".join(states),
-                       "objects": n_objects, "primary": self.id,
-                       "epoch": self.epoch})
+        with self._lock:
+            self._pg_states[(pool_id, ps)] = {
+                "state": "+".join(states), "objects": n_objects,
+                "degraded_objects": 0 if clean else degraded_objs}
+        self._send_pg_stats(pool_id, ps)
         if clean:
             self._set_pg_temp(pool_id, ps, [])
             # history behind each object's newest log record is dead
@@ -1392,6 +1526,13 @@ class OSDService(MapFollower):
                                              set()).discard(o)
                 except (TimeoutError, OSError):
                     pass
+
+    @staticmethod
+    def _shard_v_of(infos: Dict, osd: int, oid: str,
+                    pos: int) -> str:
+        return infos.get(osd, {}).get("objects", {}) \
+            .get(oid, {}).get("shards", {}) \
+            .get(str(pos), NULL_VERSION)
 
     def _recover_ec_batch(self, pool_id, ps, up, need, avail, items,
                           infos, shard_v, code) -> bool:
@@ -1466,6 +1607,7 @@ class OSDService(MapFollower):
                                  rec["v"], force=True,
                                  expect=shard_v(osd, oid, pos))
             self.pc.inc("recovered_objects")
+            self._account_io(pool_id, ps, objects_recovered=1)
         self.log.dout(5, f"pg {cid}: batch-recovered "
                          f"{len(per_obj)} objects, pattern "
                          f"need={need}")
@@ -1521,6 +1663,7 @@ class OSDService(MapFollower):
             self._push_shard(pool_id, ps, o, oid, 0, data.tobytes(),
                              size, v)
         self.pc.inc("recovered_objects")
+        self._account_io(pool_id, ps, objects_recovered=1)
         return ok
 
     def _push_shard(self, pool_id, ps, osd, oid, shard, data, size,
@@ -1541,15 +1684,22 @@ class OSDService(MapFollower):
                 # direct: the caller is already a scheduled worker or
                 # the RMW coordinator — re-submitting would deadlock
                 # the worker pool
-                return self._do_shard_write(msg)
-            # 5s: long enough for a loaded replica's fsync+queue, but
-            # a push often runs under the PG lock, so a dead peer
-            # must stop blocking the whole PG quickly (the messenger
-            # fails even faster once its resync gives the peer up)
-            return self.msgr.call(self.osd_addrs[osd], msg,
-                                  timeout=5)
+                rep = self._do_shard_write(msg)
+            else:
+                # 5s: long enough for a loaded replica's fsync+queue,
+                # but a push often runs under the PG lock, so a dead
+                # peer must stop blocking the whole PG quickly (the
+                # messenger fails even faster once its resync gives
+                # the peer up)
+                rep = self.msgr.call(self.osd_addrs[osd], msg,
+                                     timeout=5)
         except (TimeoutError, OSError):
             return None
+        if qos == "recovery" and rep is not None and rep.get("ok"):
+            self.pc.inc("recovery_bytes", len(msg["data"]))
+            self._account_io(pool_id, ps,
+                             bytes_recovered=len(msg["data"]))
+        return rep
 
     def _set_pg_temp(self, pool_id: int, ps: int,
                      osds: List[int]) -> None:

@@ -14,9 +14,10 @@ the ceph_tpu/analysis/wirecheck.py registry, the ceph-dencoder role):
 CLI:
     python -m ceph_tpu.tools.ceph_cli --mon HOST:PORT[,HOST:PORT...] \
         status | health | osd tree | osd reweight ID W | osd out ID |
-        osd down ID | pool ls | pool create ID PGS SIZE | pool delete ID
+        osd down ID | pool ls | pool create ID PGS SIZE |
+        pool delete ID | pool-stats [ID] | progress
     python -m ceph_tpu.tools.ceph_cli --asok-dir DIR \
-        daemonperf | telemetry snapshot|prom|traces
+        daemonperf | top | history | telemetry snapshot|prom|traces
     python -m ceph_tpu.tools.ceph_cli \
         dencoder list | encode TYPE | decode TYPE [HEXFILE] |
         roundtrip [TYPE]
@@ -127,18 +128,20 @@ def main(argv=None) -> int:
         return _dencoder(args.verb, extra)
 
     # the observability verbs poll admin sockets directly — no
-    # monitor, no messenger
-    if args.verb[0] in ("daemonperf", "telemetry"):
+    # monitor, no messenger.  `top` and `history` are the continuous
+    # plane (per-daemon metrics-history rings + live rate frames).
+    if args.verb[0] in ("daemonperf", "telemetry", "top",
+                        "history"):
         from . import telemetry
 
         if not args.asok_dir:
-            print("daemonperf/telemetry need --asok-dir",
+            print("daemonperf/telemetry/top/history need --asok-dir",
                   file=sys.stderr)
             return 2
-        sub = args.verb[1] if args.verb[0] == "telemetry" and \
-            len(args.verb) > 1 else (
-                "daemonperf" if args.verb[0] == "daemonperf"
-                else "snapshot")
+        if args.verb[0] == "telemetry":
+            sub = args.verb[1] if len(args.verb) > 1 else "snapshot"
+        else:
+            sub = args.verb[0]
         return telemetry.main(["--asok-dir", args.asok_dir, sub]
                               + args.verb[2:] + extra)
 
@@ -231,6 +234,36 @@ def main(argv=None) -> int:
         elif v[:2] == ["pool", "delete"] and len(v) == 3:
             rc = mutate(call({"type": "pool_delete",
                               "pool_id": int(v[2])}))
+        elif v[0] == "pool-stats":
+            msg = {"type": "pool_stats"}
+            if len(v) > 1:
+                msg["pool"] = int(v[1])
+            got = call(msg)
+            for pid, st in sorted(got.get("pools", {}).items()):
+                cur = st.get("current", {})
+                last = (st.get("series") or [{}])[-1]
+                print(f"pool {pid}: {cur.get('objects', 0)} objects, "
+                      f"{cur.get('degraded_pgs', 0)} pgs degraded; "
+                      f"wr {last.get('wr_bps', 0.0):.0f} B/s "
+                      f"({last.get('wr_ops_s', 0.0):.1f} op/s), "
+                      f"rd {last.get('rd_bps', 0.0):.0f} B/s, "
+                      f"recovery "
+                      f"{last.get('recovery_bps', 0.0):.0f} B/s")
+            print(json.dumps(got))
+        elif v[0] == "progress":
+            got = call({"type": "progress"})
+            events = got.get("events", [])
+            if not events:
+                print("progress: nothing in progress")
+            for ev in events:
+                bar_w = 30
+                frac = float(ev.get("fraction", 0.0))
+                fill = int(bar_w * max(0.0, min(1.0, frac)))
+                state = "done" if ev.get("done") else \
+                    f"{ev.get('rate_bps', 0.0):.0f} B/s"
+                print(f"  {ev.get('id')}: "
+                      f"[{'=' * fill}{'.' * (bar_w - fill)}] "
+                      f"{frac * 100:.1f}% ({state})")
         else:
             print(f"unknown or incomplete verb: {' '.join(v)}",
                   file=sys.stderr)

@@ -16,10 +16,16 @@ cluster snapshot, and render it three ways:
   tree — the client → messenger → primary OSD → EC encode → shard
   fan-out picture of a single op.
 
+- the continuous plane: ``history`` scrapes every daemon's
+  ``dump_metrics_history`` ring into one time-aligned cluster series
+  (daemonperf-over-time), and ``top`` renders live rate frames with
+  cluster totals (the `ceph_cli top` view).
+
 CLI:
     python -m ceph_tpu.tools.telemetry --asok-dir DIR \
         snapshot | prom | daemonperf [--interval S] [--count N] | \
-        traces [--trace-id ID] [--root NAME]
+        traces [--trace-id ID] [--root NAME] | \
+        history [--last N] [--json] | top [--interval S] [--count N]
 """
 
 from __future__ import annotations
@@ -90,25 +96,53 @@ def cluster_snapshot(asok_dir: Optional[str] = None,
 # -- prometheus text exposition ---------------------------------------
 
 def _sanitize(name: str) -> str:
-    return re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    """Metric-name charset is [a-zA-Z_:][a-zA-Z0-9_:]* — dotted
+    counter names (``ec.engine``-style keys) sanitize to
+    underscores, and a leading digit gets a guard underscore."""
+    name = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    return "_" + name if re.match(r"^[0-9]", name) else name
+
+
+def _escape_label(value: str) -> str:
+    """Label values are quoted strings with \\, \" and newline
+    escaped (the exposition-format grammar) — daemon names are
+    user-chosen and must not be able to break a scrape."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 def to_prometheus(snapshot: Dict, prefix: str = "ceph_tpu") -> str:
-    """Prometheus text format.  Counter types survive the wire only
-    structurally: plain numbers emit as untyped samples, {avgcount,
-    sum} pairs as _sum/_count, {buckets, min} log2 histograms as
-    cumulative _bucket{le=...} + _count (le bounds are min * 2^i —
-    bucket 0 is everything <= min)."""
-    lines: List[str] = []
+    """Prometheus text exposition.  Counter types survive the wire
+    only structurally: plain numbers emit as untyped samples,
+    {avgcount, sum} pairs as summary _sum/_count, {buckets, min} log2
+    histograms as cumulative _bucket{le=...} + _count (le bounds are
+    min * 2^i — bucket 0 is everything <= min).  Each metric FAMILY
+    gets exactly one ``# HELP``/``# TYPE`` pair with every sample of
+    the family grouped under it (the text-format grammar requirement
+    a multi-daemon snapshot used to violate)."""
+    fams: Dict[str, Dict] = {}
+
+    def fam(metric: str, ptype: str, key: str) -> List[str]:
+        f = fams.get(metric)
+        if f is None:
+            f = fams[metric] = {
+                "type": ptype,
+                "help": f"ceph_tpu counter {key}"
+                .replace("\\", "").replace("\n", " "),
+                "lines": []}
+        return f["lines"]
+
     for daemon, data in sorted(snapshot.get("daemons", {}).items()):
         for logger, counters in sorted((data.get("perf")
                                         or {}).items()):
             if not isinstance(counters, dict):
                 continue
-            labels = (f'daemon="{daemon}",logger="{logger}"')
+            labels = (f'daemon="{_escape_label(daemon)}",'
+                      f'logger="{_escape_label(logger)}"')
             for key, val in sorted(counters.items()):
                 metric = f"{prefix}_{_sanitize(key)}"
                 if isinstance(val, dict) and "buckets" in val:
+                    lines = fam(metric, "histogram", key)
                     lo = float(val.get("min", 1.0))
                     cum = 0
                     for i, n in enumerate(val["buckets"]):
@@ -120,13 +154,21 @@ def to_prometheus(snapshot: Dict, prefix: str = "ceph_tpu") -> str:
                                  f'le="+Inf"}} {cum}')
                     lines.append(f"{metric}_count{{{labels}}} {cum}")
                 elif isinstance(val, dict) and "avgcount" in val:
+                    lines = fam(metric, "summary", key)
                     lines.append(f"{metric}_sum{{{labels}}} "
                                  f"{val.get('sum', 0)}")
                     lines.append(f"{metric}_count{{{labels}}} "
                                  f"{val.get('avgcount', 0)}")
                 elif isinstance(val, (int, float)):
-                    lines.append(f"{metric}{{{labels}}} {val}")
-    return "\n".join(lines) + ("\n" if lines else "")
+                    fam(metric, "untyped", key).append(
+                        f"{metric}{{{labels}}} {val}")
+    out: List[str] = []
+    for metric in sorted(fams):
+        f = fams[metric]
+        out.append(f"# HELP {metric} {f['help']}")
+        out.append(f"# TYPE {metric} {f['type']}")
+        out.extend(f["lines"])
+    return "\n".join(out) + ("\n" if out else "")
 
 
 # -- daemonperf (columnar rates between two polls) --------------------
@@ -188,6 +230,79 @@ def daemonperf_view(prev: Dict, cur: Dict,
             cells.append(f"{rate:.1f}".rjust(width))
         lines.append(daemon.ljust(name_w) + "".join(cells))
     return "\n".join(lines)
+
+
+# -- metrics history (daemonperf-over-time) ---------------------------
+
+def gather_history(asok_dir: Optional[str] = None,
+                   paths: Optional[Dict[str, str]] = None,
+                   timeout: float = 5.0,
+                   last: Optional[int] = None) -> Dict[str, Dict]:
+    """Scrape every daemon's ``dump_metrics_history`` ring; daemons
+    without the command (or unreachable) are skipped, not fatal."""
+    assert asok_dir is not None or paths is not None
+    targets = dict(paths or {})
+    if asok_dir is not None:
+        targets = {**discover(asok_dir), **targets}
+    out: Dict[str, Dict] = {}
+    for name, path in sorted(targets.items()):
+        args = {"last": last} if last else {}
+        try:
+            got = AdminSocket.request(path, "dump_metrics_history",
+                                      timeout=timeout, **args)
+        except (OSError, ValueError):
+            continue
+        if isinstance(got, dict) and "samples" in got:
+            out[name] = got
+    return out
+
+
+def history_view(histories: Dict[str, Dict],
+                 columns: Optional[List[Tuple[str, str, str]]] = None,
+                 bucket_s: float = 1.0) -> str:
+    """The time-aligned cluster series: every daemon's ring merged
+    into one table — rows are time buckets, columns are the
+    daemonperf rate columns summed across daemons.  The
+    `daemonperf-over-time` view ROADMAP items 1/3/4 hang their
+    scaling/saturation measurements on."""
+    columns = columns or DEFAULT_COLUMNS
+    headers = [h for _g, _k, h in columns]
+    buckets: Dict[float, Dict[str, float]] = {}
+    for _daemon, hist in sorted(histories.items()):
+        samples = hist.get("samples", [])
+        for a, b in zip(samples, samples[1:]):
+            dt = max(1e-9, b.get("mono", 0) - a.get("mono", 0))
+            bucket = round(b.get("ts", 0) / bucket_s) * bucket_s
+            row = buckets.setdefault(bucket,
+                                     {h: 0.0 for h in headers})
+            for lg, key, hdr in columns:
+                delta = (_column_value(b.get("perf", {}), lg, key)
+                         - _column_value(a.get("perf", {}), lg, key))
+                row[hdr] += max(0.0, delta) / dt
+    width = max(8, *(len(h) + 1 for h in headers))
+    lines = ["time".ljust(9)
+             + "".join(h.rjust(width) for h in headers)]
+    for ts in sorted(buckets):
+        stamp = time.strftime("%H:%M:%S", time.localtime(ts))
+        lines.append(stamp.ljust(9) + "".join(
+            f"{buckets[ts][h]:.1f}".rjust(width) for h in headers))
+    return "\n".join(lines)
+
+
+def top_view(prev: Dict, cur: Dict) -> str:
+    """One `ceph_cli top` frame: cluster totals header + the
+    daemonperf rate table between the two snapshots."""
+    daemons = cur.get("daemons", {})
+    inflight = 0
+    for data in daemons.values():
+        ops = data.get("ops_in_flight") or {}
+        inflight += int(ops.get("num_ops", 0) or 0)
+    stamp = time.strftime("%H:%M:%S",
+                          time.localtime(cur.get("ts", 0)))
+    head = (f"ceph-tpu top — {stamp}  daemons: {len(daemons)}"
+            f"  unreachable: {len(cur.get('unreachable', []))}"
+            f"  ops in flight: {inflight}")
+    return head + "\n\n" + daemonperf_view(prev, cur)
 
 
 # -- cross-daemon trace reassembly ------------------------------------
@@ -289,16 +404,47 @@ def main(argv=None) -> int:
     ap.add_argument("--asok-dir", required=True,
                     help="directory of daemon *.asok sockets")
     ap.add_argument("cmd", choices=("snapshot", "prom", "traces",
-                                    "daemonperf"))
+                                    "daemonperf", "history", "top"))
     ap.add_argument("--trace-id", help="traces: reassemble this id")
     ap.add_argument("--root",
                     help="traces: only traces whose root span has "
                          "this name")
     ap.add_argument("--interval", type=float, default=1.0,
-                    help="daemonperf: seconds between polls")
+                    help="daemonperf/top: seconds between polls")
     ap.add_argument("--count", type=int, default=1,
-                    help="daemonperf: rows of rates to print")
+                    help="daemonperf/top: frames to print")
+    ap.add_argument("--last", type=int, default=None,
+                    help="history: samples per daemon (default all)")
+    ap.add_argument("--json", action="store_true",
+                    help="history: raw merged rings as JSON")
     args = ap.parse_args(argv)
+
+    if args.cmd == "history":
+        hist = gather_history(args.asok_dir, last=args.last)
+        if not hist:
+            print(f"no metrics history under {args.asok_dir} "
+                  f"(metrics_history_interval disabled?)",
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(hist, indent=1, default=str))
+        else:
+            print(history_view(hist))
+        return 0
+    if args.cmd == "top":
+        prev = cluster_snapshot(args.asok_dir)
+        if not prev["daemons"]:
+            print(f"no reachable daemons under {args.asok_dir}",
+                  file=sys.stderr)
+            return 1
+        for i in range(max(1, args.count)):
+            time.sleep(args.interval)
+            cur = cluster_snapshot(args.asok_dir)
+            if sys.stdout.isatty():
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            print(top_view(prev, cur))
+            prev = cur
+        return 0
 
     snap = cluster_snapshot(args.asok_dir)
     if not snap["daemons"]:

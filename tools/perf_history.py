@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""perf_history — the bench trajectory table with regression deltas.
+
+Every committed ``BENCH_rNN.json`` records one driver bench run
+(headline JSON under ``parsed``, the run's stderr under ``tail``).
+Until now comparing runs was archaeology: open two files, grep the
+tails, eyeball the numbers.  This tool ingests the whole series and
+renders it as a trajectory table — one row per run, one column per
+metric, with per-metric percentage deltas vs the previous run that
+recorded the metric — and turns regressions into a red check:
+
+    python tools/perf_history.py              # table, r01 -> rNN
+    python tools/perf_history.py --check      # exit 1 if the LATEST
+                                              # run regressed any
+                                              # throughput metric
+                                              # beyond --threshold
+    python tools/perf_history.py --json       # rows as JSON
+
+Metrics come from two places: the structured headline (``parsed``:
+crush mappings/s, vs_baseline, and — from this PR on — the ``slo``
+block), and the stderr tail (cluster IOPS, EC GB/s, batched-encode
+speedup, and the staged lane's backend-init outcome: ``init_probe_s``
+is how long the run burned before giving up on a dead accelerator
+tunnel — the fail-fast satellite's acceptance signal).
+
+Regression policy: throughput metrics (higher is better) flag when
+they drop more than ``--threshold`` (default 25%) vs the previous
+recorded value; ``init_probe_s`` (lower is better) flags when it
+grows past the fail-fast deadline band.  SLO blocks recorded by the
+bench itself flag directly when ``pass`` is false.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+# (metric, higher_is_better) — column order of the table
+METRICS = [
+    ("crush_mappings_s", True),
+    ("vs_baseline", True),
+    ("cluster_wr_iops", True),
+    ("cluster_seq_iops", True),
+    ("ec_encode_gbps", True),
+    ("ec_batch_speedup", True),
+    ("init_probe_s", False),
+]
+
+_TAIL_PATTERNS = {
+    "cluster_wr_iops": re.compile(
+        r"# cluster [^:]*: write ([\d.]+) IOPS"),
+    "cluster_seq_iops": re.compile(r"; seq ([\d.]+) IOPS"),
+    "ec_encode_gbps": re.compile(
+        r"# ec k=8,m=3: encode ([\d.]+) GB/s"),
+    "ec_batch_speedup": re.compile(
+        r"# ec batched encode .*\(([\d.]+)x\)"),
+}
+_INIT_KILL = re.compile(
+    r"# staged/default: killed \((?:no init line|deadline)[^)]*\) "
+    r"at t=([\d.]+)s")
+_INIT_HANG_LEGACY = re.compile(
+    r"backend never initialized within ([\d.]+)s")
+
+
+def load_run(path: str) -> Optional[Dict]:
+    try:
+        raw = json.load(open(path))
+    except (OSError, ValueError) as e:
+        print(f"# {path}: unreadable ({e})", file=sys.stderr)
+        return None
+    parsed = raw.get("parsed") or {}
+    tail = raw.get("tail") or ""
+    row: Dict = {
+        "run": f"r{int(raw.get('n', 0)):02d}",
+        "n": int(raw.get("n", 0)),
+        "path": os.path.basename(path),
+        "rc": raw.get("rc"),
+        "platform": parsed.get("platform"),
+        "metrics": {},
+        "slo_fail": [],
+    }
+    if isinstance(parsed.get("value"), (int, float)):
+        row["metrics"]["crush_mappings_s"] = float(parsed["value"])
+    if isinstance(parsed.get("vs_baseline"), (int, float)):
+        row["metrics"]["vs_baseline"] = float(parsed["vs_baseline"])
+    for metric, pat in _TAIL_PATTERNS.items():
+        m = pat.search(tail)
+        if m:
+            row["metrics"][metric] = float(m.group(1))
+    # how long the staged lane burned before the accelerator verdict:
+    # the backend-init fail-fast probe should cap this at ~60 s (the
+    # r05 run burned 300 s; the probe landed after that measurement)
+    m = _INIT_KILL.search(tail) or _INIT_HANG_LEGACY.search(tail)
+    if m:
+        row["metrics"]["init_probe_s"] = float(m.group(1))
+    elif parsed.get("backend_init_failed"):
+        row["metrics"]["init_probe_s"] = float(
+            os.environ.get("CEPH_TPU_BENCH_INIT_DEADLINE", 60))
+    slo = parsed.get("slo")
+    if isinstance(slo, dict) and slo.get("pass") is False:
+        row["slo_fail"].append(slo.get("metric", "headline"))
+    for m_ in re.finditer(r"# slo (\S+): .*-> FAIL", tail):
+        row["slo_fail"].append(m_.group(1))
+    return row
+
+
+def load_all(directory: str) -> List[Dict]:
+    rows = []
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "BENCH_r*.json"))):
+        row = load_run(path)
+        if row is not None:
+            rows.append(row)
+    rows.sort(key=lambda r: r["n"])
+    return rows
+
+
+def compute_deltas(rows: List[Dict],
+                   threshold: float = 0.25) -> None:
+    """Annotate each row with per-metric % delta vs the previous run
+    that recorded the metric, and a ``regressions`` list for drops
+    (or, for lower-is-better metrics, growth) beyond the threshold."""
+    last_seen: Dict[str, float] = {}
+    for row in rows:
+        row["deltas"] = {}
+        row["regressions"] = list(row["slo_fail"])
+        for metric, higher_better in METRICS:
+            val = row["metrics"].get(metric)
+            if val is None:
+                continue
+            prev = last_seen.get(metric)
+            if prev not in (None, 0):
+                pct = (val - prev) / abs(prev)
+                row["deltas"][metric] = pct
+                regressed = (pct < -threshold) if higher_better \
+                    else (pct > threshold)
+                if regressed:
+                    row["regressions"].append(
+                        f"{metric} {prev:g} -> {val:g} "
+                        f"({pct * 100:+.0f}%)")
+            last_seen[metric] = val
+
+
+def render(rows: List[Dict]) -> str:
+    headers = ["run"] + [m for m, _ in METRICS] + ["flags"]
+    widths = [max(len(h), 14) for h in headers]
+    widths[0] = 5
+
+    def cell(row: Dict, metric: str) -> str:
+        val = row["metrics"].get(metric)
+        if val is None:
+            return "-"
+        pct = row["deltas"].get(metric)
+        s = f"{val:g}"
+        if pct is not None:
+            s += f" ({pct * 100:+.0f}%)"
+        return s
+
+    lines = ["".join(h.ljust(w + 1) for h, w in zip(headers,
+                                                    widths))]
+    for row in rows:
+        flags = "REGRESSED" if row["regressions"] else "ok"
+        cells = [row["run"]] + [cell(row, m) for m, _ in METRICS] \
+            + [flags]
+        lines.append("".join(c.ljust(w + 1)
+                             for c, w in zip(cells, widths)))
+    for row in rows:
+        for reg in row["regressions"]:
+            lines.append(f"  ! {row['run']}: {reg}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="perf_history")
+    ap.add_argument("directory", nargs="?",
+                    default=os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))),
+                    help="directory holding BENCH_r*.json "
+                         "(default: repo root)")
+    ap.add_argument("--threshold", type=float, default=0.25,
+                    help="fractional drop that counts as a "
+                         "regression (default 0.25)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 when the LATEST run regressed")
+    ap.add_argument("--json", action="store_true",
+                    help="emit rows as JSON instead of the table")
+    args = ap.parse_args(argv)
+
+    rows = load_all(args.directory)
+    if not rows:
+        print(f"no BENCH_r*.json under {args.directory}",
+              file=sys.stderr)
+        return 2
+    compute_deltas(rows, threshold=args.threshold)
+    if args.json:
+        print(json.dumps(rows, indent=1))
+    else:
+        print(render(rows))
+    if args.check and rows[-1]["regressions"]:
+        print(f"REGRESSION in {rows[-1]['run']}: "
+              f"{rows[-1]['regressions']}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
