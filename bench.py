@@ -62,6 +62,8 @@ INIT_DEADLINE = float(os.environ.get("CEPH_TPU_BENCH_INIT_DEADLINE",
                                      60))
 CPU_DEADLINE = float(os.environ.get("CEPH_TPU_BENCH_CPU_DEADLINE", 270))
 EC_DEADLINE = float(os.environ.get("CEPH_TPU_BENCH_EC_DEADLINE", 150))
+MULTICHIP_DEADLINE = float(os.environ.get(
+    "CEPH_TPU_BENCH_MULTICHIP_DEADLINE", 420))
 
 RESULT_TAG = "BENCH_RESULT "
 
@@ -79,6 +81,15 @@ SLO_FLOORS = {
         "CEPH_TPU_SLO_EC_BATCH_FLOOR", 1.5)),
     "cluster_write_iops": float(os.environ.get(
         "CEPH_TPU_SLO_CLUSTER_IOPS_FLOOR", 100)),
+    # the multichip lane's floor is the N-DEVICE absolute throughput,
+    # set low enough that N virtual devices time-slicing ONE CPU core
+    # still clear it (the lane's job on CPU CI is producing the
+    # per-device breakdown + efficiency figure; perf_history red-checks
+    # run-over-run efficiency drops, which is where regressions show)
+    "multichip_crush_mappings_per_sec": float(os.environ.get(
+        "CEPH_TPU_SLO_MULTICHIP_CRUSH_FLOOR", 500)),
+    "multichip_encode_gbps": float(os.environ.get(
+        "CEPH_TPU_SLO_MULTICHIP_EC_FLOOR", 0.01)),
 }
 
 
@@ -516,6 +527,120 @@ def worker_cluster():
                    p99_ms=out["write"].get("lat_p99_ms")))
 
 
+def worker_multichip():
+    """The multichip scaling lane (ROADMAP item 1's acceptance gate):
+    the mesh-sharded data plane measured 1-device vs N-device —
+    PlacementPlane CRUSH mappings/s and stripe-batch-sharded EC encode
+    GB/s — with a computed scaling-efficiency figure (N-device
+    throughput / (N x 1-device)) and the per-device work breakdown in
+    the stage JSON.
+
+    On a host with no accelerator the worker forces the CPU backend to
+    expose N virtual devices (--xla_force_host_platform_device_count,
+    the dryrun/conftest layout): same code path, same breakdown, and
+    the SLO floors are set so one core time-slicing N virtual devices
+    still clears them.  Env knobs (the tier-1 smoke test shrinks the
+    workload): CEPH_TPU_MULTICHIP_DEVICES / _MAP / _BATCH / _ITERS."""
+    n_want = int(os.environ.get("CEPH_TPU_MULTICHIP_DEVICES", 8))
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags +
+            f" --xla_force_host_platform_device_count={n_want}").strip()
+    t_boot = time.perf_counter()
+    import jax
+
+    _enable_compile_cache()
+    plat = jax.devices()[0].platform
+    devs = jax.devices()
+    _emit(stage="init", platform=plat,
+          init_s=round(time.perf_counter() - t_boot, 1),
+          n_devices=len(devs))
+
+    import numpy as np
+
+    from ceph_tpu.parallel.placement import (PlacementPlane, make_mesh,
+                                             mesh_device_report)
+
+    on_accel = plat != "cpu"
+    map_name = os.environ.get(
+        "CEPH_TPU_MULTICHIP_MAP", "map_big10k")
+    batch = int(os.environ.get(
+        "CEPH_TPU_MULTICHIP_BATCH", (1 << 16) if on_accel else 4096))
+    iters = int(os.environ.get(
+        "CEPH_TPU_MULTICHIP_ITERS", 8 if on_accel else 4))
+
+    cmap, case = _load_case(map_name)
+    weight = case["weight_np"]
+    mesh1 = make_mesh(devs[:1])
+    meshN = make_mesh(devs)
+    n_dev = len(devs)
+    c0 = _lib_counters()
+
+    def measure_plane(mesh, label):
+        plane = PlacementPlane(cmap, mesh=mesh)
+        # warmup = compile; golden-validate the sharded results
+        res, lens = plane.map_batch(case["ruleno"],
+                                    np.arange(batch, dtype=np.uint32),
+                                    case["numrep"], weight)
+        jax.block_until_ready(res)
+        _golden_check(case, np.asarray(res), np.asarray(lens),
+                      f"{plat}/multichip/{label}")
+        t0 = time.perf_counter()
+        for i in range(iters):
+            xs = np.arange(i * batch, (i + 1) * batch,
+                           dtype=np.uint32)
+            res, lens = plane.map_batch(case["ruleno"], xs,
+                                        case["numrep"], weight)
+        jax.block_until_ready(res)
+        dt = time.perf_counter() - t0
+        return batch * iters / dt
+
+    crush_1 = measure_plane(mesh1, "1dev")
+    crush_n = measure_plane(meshN, f"{n_dev}dev")
+    crush_eff = crush_n / (n_dev * crush_1) if crush_1 else 0.0
+
+    # EC: the stripe-batch-sharded encode, RS(8,3) over B stripes
+    from ceph_tpu.ec.rs_jax import RSCode
+
+    bc = RSCode(8, 3)._bit
+    B = int(os.environ.get("CEPH_TPU_MULTICHIP_EC_BATCH", 16))
+    chunk = int(os.environ.get(
+        "CEPH_TPU_MULTICHIP_EC_CHUNK",
+        (1 << 18) if on_accel else (1 << 16)))
+    rng = np.random.default_rng(5)
+    stripes = rng.integers(0, 256, (B, 8, chunk), dtype=np.uint8)
+    ec_iters = max(2, iters)
+
+    def measure_encode(mesh):
+        out = bc.encode_batched_sharded(stripes, mesh)
+        jax.block_until_ready(out)  # warmup/compile
+        t0 = time.perf_counter()
+        for _ in range(ec_iters):
+            out = bc.encode_batched_sharded(stripes, mesh)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        return B * 8 * chunk * ec_iters / dt / 1e9
+
+    ec_1 = measure_encode(mesh1)
+    ec_n = measure_encode(meshN)
+    ec_eff = ec_n / (n_dev * ec_1) if ec_1 else 0.0
+
+    _emit(stage="multichip", platform=plat, n_devices=n_dev,
+          map=map_name, batch=batch, iters=iters,
+          crush_1dev_mappings_per_sec=round(crush_1, 1),
+          crush_ndev_mappings_per_sec=round(crush_n, 1),
+          crush_scaling_efficiency=round(crush_eff, 4),
+          ec_batch=B, ec_chunk=chunk,
+          ec_1dev_gbps=round(ec_1, 4),
+          ec_ndev_gbps=round(ec_n, 4),
+          ec_scaling_efficiency=round(ec_eff, 4),
+          per_device=mesh_device_report(meshN),
+          counters=_counter_deltas(c0, _lib_counters()),
+          slo=[_slo("multichip_crush_mappings_per_sec", crush_n),
+               _slo("multichip_encode_gbps", ec_n)])
+
+
 # ---------------------------------------------------------------------------
 # parent side (orchestration; no jax import)
 # ---------------------------------------------------------------------------
@@ -778,6 +903,39 @@ def main():
     clw = Stream(_spawn("cluster", "cpu"), "cluster/cpu")
     cl_res = clw.wait(lambda r: r.get("stage") == "cluster", 120)
     clw.kill("done")
+    # multichip scaling phase (ROADMAP item 1's measurement surface):
+    # ride the accelerator when the staged lane proved one is alive,
+    # else the 8-virtual-device CPU mesh; same init fail-fast probe as
+    # the staged lane so a dead tunnel costs INIT_DEADLINE, not the
+    # full multichip budget
+    mc_plat = "default" if headline.get("platform") not in (
+        None, "cpu", "none") else "cpu"
+    mcw = Stream(_spawn("multichip", mc_plat), f"multichip/{mc_plat}")
+    mc_res = None
+    if mcw.wait(lambda r: r.get("stage") == "init",
+                min(INIT_DEADLINE, MULTICHIP_DEADLINE)) is None:
+        mcw.kill("no init line — backend init hang")
+    else:
+        mc_res = mcw.wait(lambda r: r.get("stage") == "multichip",
+                          MULTICHIP_DEADLINE)
+    mcw.kill("done")
+    if mc_res is not None:
+        print(f"# multichip {mc_res['n_devices']}-dev "
+              f"({mc_res['platform']}): crush "
+              f"{mc_res['crush_ndev_mappings_per_sec']} vs "
+              f"{mc_res['crush_1dev_mappings_per_sec']} mappings/s "
+              f"1-dev (eff {mc_res['crush_scaling_efficiency']}); "
+              f"ec encode {mc_res['ec_ndev_gbps']} vs "
+              f"{mc_res['ec_1dev_gbps']} GB/s 1-dev (eff "
+              f"{mc_res['ec_scaling_efficiency']})", file=sys.stderr)
+        print("# multichip json: " + json.dumps(mc_res),
+              file=sys.stderr)
+        for blk in mc_res.get("slo") or []:
+            if "pass" in blk:
+                print(f"# slo {blk['metric']}: value "
+                      f"{blk.get('value')} floor {blk.get('floor')} "
+                      f"-> {'PASS' if blk['pass'] else 'FAIL'}",
+                      file=sys.stderr)
     if cl_res is not None:
         print(f"# cluster 4-osd: write {cl_res['write_iops']} IOPS "
               f"({cl_res['write_mbps']} MB/s, p99 "
@@ -805,6 +963,7 @@ if __name__ == "__main__":
          "ec_cpu": worker_ec_cpu,
          "ec_profiles": lambda: _try_stage(
              "ec/profiles", _stage_ec_profiles),
-         "cluster": worker_cluster}[sys.argv[2]]()
+         "cluster": worker_cluster,
+         "multichip": worker_multichip}[sys.argv[2]]()
     else:
         main()

@@ -606,10 +606,79 @@ def _contract_encode_batched() -> List[Case]:
     return out
 
 
+def _contract_sharded_rule_fn() -> List[Case]:
+    """parallel.sharded_rule_fn (the PlacementPlane engine): the
+    masked, PG-axis-sharded batched mapper over a 1-device mesh (the
+    degenerate CI case) and the full device mesh when more than one
+    device exists.  Outputs: PG-sharded (results, lens) plus — with
+    gather_stats — the all-reduced utilization tally, all int32."""
+    import jax
+
+    from ..crush.builder import sample_cluster_map
+    from ..parallel.placement import make_mesh, sharded_rule_fn
+
+    cmap = sample_cluster_map(racks=2, hosts_per_rack=2,
+                              osds_per_host=2)
+    devs = jax.devices()
+    meshes = [(1, make_mesh(devs[:1]))]
+    if len(devs) > 1:
+        meshes.append((len(devs), make_mesh(devs)))
+    out: List[Case] = []
+    for n_dev, mesh in meshes:
+        for gather in (False, True):
+            fn, static, arrays = sharded_rule_fn(
+                cmap, 0, 3, mesh, gather_stats=gather, masked=True)
+            N = 64
+            args = [
+                jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    arrays),
+                jax.ShapeDtypeStruct((cmap.max_devices,), "uint32"),
+                jax.ShapeDtypeStruct((N,), "uint32"),
+                jax.ShapeDtypeStruct((N,), "bool"),
+            ]
+            want = [((N, 3), "int32"), ((N,), "int32")]
+            if gather:
+                want.append(((static.max_devices,), "int32"))
+            out.append(Case(
+                f"rule0/R=3/N={N}/ndev={n_dev}/gather={gather}",
+                fn, args, want))
+    return out
+
+
+def _contract_encode_batched_sharded() -> List[Case]:
+    """ec.engine.encode_batched_sharded: the stripe-batch-sharded
+    encode — u8[B, k, L] with B sharded across the mesh -> parity
+    u8[B, m, L] sharded the same way, on the 1-device degenerate mesh
+    and the full mesh."""
+    import jax
+
+    from ..ec.rs_jax import RSCode
+    from ..parallel.placement import make_mesh
+
+    devs = jax.devices()
+    meshes = [(1, make_mesh(devs[:1], axis_name="ec"))]
+    if len(devs) > 1:
+        meshes.append((len(devs), make_mesh(devs, axis_name="ec")))
+    out: List[Case] = []
+    for k, m, B, L in ((4, 2, 8, 4096), (8, 3, 16, 1024)):
+        bc = RSCode(k, m)._bit
+        for n_dev, mesh in meshes:
+            fn = bc._mesh_fn(mesh, "ec")
+            out.append(Case(
+                f"rs(k={k},m={m})/B={B}/L={L}/ndev={n_dev}", fn,
+                [_u8(B, k, L)], [((B, m, L), "uint8")]))
+    return out
+
+
 def _register_builtin_contracts() -> None:
     register_contract("ec.engine.mod2_matmul", _contract_mod2_matmul)
     register_contract("ec.engine.encode_batched",
                       _contract_encode_batched)
+    register_contract("ec.engine.encode_batched_sharded",
+                      _contract_encode_batched_sharded)
+    register_contract("parallel.sharded_rule_fn",
+                      _contract_sharded_rule_fn)
     register_contract("ec.rs_jax", _contract_rs_jax)
     register_contract("ec.jerasure", _contract_jerasure)
     register_contract("ec.isa", _contract_isa)

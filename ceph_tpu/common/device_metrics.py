@@ -45,6 +45,15 @@ _shapes: Dict[str, Dict[str, float]] = {}
 _shapes_lock = make_lock("device::shapes")
 _buffer_hw = 0
 
+# device id -> aggregate mesh-launch stats: the per-chip half of the
+# multichip story.  A pjit launch over an N-device mesh is SPMD — every
+# chip runs the program for ~the wall time while holding 1/N of the
+# sharded data — so each participating device books the full wall time
+# and its 1/N share of the transfer volume.  mesh_device_report joins
+# this onto the per-device id/platform/memory rows, which is how the
+# multichip bench lane proves real work landed on every chip.
+_mesh_devices: Dict[int, Dict[str, float]] = {}
+
 
 def record_launch(logger: str, sig: object, seconds: float,
                   h2d_bytes: int = 0, d2h_bytes: int = 0) -> None:
@@ -69,6 +78,36 @@ def record_launch(logger: str, sig: object, seconds: float,
         rec["time_s"] += seconds
         rec["h2d_bytes"] += h2d_bytes
         rec["d2h_bytes"] += d2h_bytes
+
+
+def record_mesh_launch(logger: str, sig: object, seconds: float,
+                       device_ids, h2d_bytes: int = 0,
+                       d2h_bytes: int = 0) -> None:
+    """Book one mesh (pjit) launch: the aggregate booking of
+    ``record_launch`` plus a per-device row for every mesh participant,
+    so ``mesh_device_report`` shows kernel time on every chip rather
+    than one hot device and N-1 idle rows."""
+    ids = [int(i) for i in device_ids]
+    record_launch(logger, sig, seconds,
+                  h2d_bytes=h2d_bytes, d2h_bytes=d2h_bytes)
+    n = max(1, len(ids))
+    with _shapes_lock:
+        for did in ids:
+            rec = _mesh_devices.get(did)
+            if rec is None:
+                rec = _mesh_devices[did] = {
+                    "launches": 0, "kernel_time_s": 0.0,
+                    "h2d_bytes": 0, "d2h_bytes": 0}
+            rec["launches"] += 1
+            rec["kernel_time_s"] += seconds
+            rec["h2d_bytes"] += h2d_bytes // n
+            rec["d2h_bytes"] += d2h_bytes // n
+
+
+def mesh_device_table() -> Dict[int, Dict[str, float]]:
+    """Per-device mesh-launch aggregates (copied)."""
+    with _shapes_lock:
+        return {k: dict(v) for k, v in _mesh_devices.items()}
 
 
 def shape_table() -> Dict[str, Dict[str, float]]:
@@ -128,4 +167,5 @@ def reset_for_tests() -> None:
     global _buffer_hw
     with _shapes_lock:
         _shapes.clear()
+        _mesh_devices.clear()
     _buffer_hw = 0

@@ -822,15 +822,48 @@ def build_rule_fn(cmap: CrushMap, ruleno: int, result_max: int,
     return batched, static, arrays_np
 
 
+def book_map_batch(sig, dt: float, n_xs: int, result_max: int,
+                   first_launch: bool, h2d_bytes: int, d2h_bytes: int,
+                   device_ids=None) -> None:
+    """Shared perf/device-plane booking for one batched-mapper launch
+    (the single-device ``BatchedMapper`` and the mesh-sharded
+    ``parallel.PlacementPlane`` both land here, so `perf dump` and the
+    recompile-budget gate see ONE ``crush.mapper`` story).  First-call
+    compiles book separately from steady-state latency; mesh launches
+    additionally book a per-device row for every participating chip."""
+    _pc.inc("map_calls")
+    _pc.inc("xs_mapped", n_xs)
+    if first_launch:
+        _pc.inc("jit_compiles")
+        _pc.tinc("jit_compile_time", dt)
+    else:
+        _pc.tinc("map_time", dt)
+        _pc.hist_add("map_lat", dt)
+    if device_ids:
+        device_metrics.record_mesh_launch(
+            "crush.mapper", sig, dt, device_ids,
+            h2d_bytes=h2d_bytes, d2h_bytes=d2h_bytes)
+    else:
+        device_metrics.record_launch(
+            "crush.mapper", sig, dt,
+            h2d_bytes=h2d_bytes, d2h_bytes=d2h_bytes)
+
+
 class BatchedMapper:
     """User-facing handle: compile-per-rule cache + array residency.
 
     >>> m = BatchedMapper(cmap)
     >>> res, lens = m.map_batch(ruleno, xs, result_max, weight)
+
+    ``mesh``: a ``jax.sharding.Mesh`` routes every ``map_batch``
+    through the mesh-sharded ``parallel.PlacementPlane`` (PG axis
+    data-parallel across the mesh devices, map arrays replicated) —
+    same results, same booking, one pjit launch over all chips.
     """
 
     def __init__(self, cmap: CrushMap,
-                 choose_args: Optional[ChooseArgMap] = None):
+                 choose_args: Optional[ChooseArgMap] = None,
+                 mesh=None):
         self.cmap = cmap
         self.choose_args = choose_args
         self._cache = {}
@@ -838,6 +871,14 @@ class BatchedMapper:
         self._encoded = encode_map(cmap, choose_args)
         self._arrays = jax.tree_util.tree_map(
             jnp.asarray, self._encoded[1])
+        self._plane = None
+        if mesh is not None:
+            # deferred import: parallel.placement imports this module
+            from ..parallel.placement import PlacementPlane
+
+            self._plane = PlacementPlane(cmap, choose_args=choose_args,
+                                         mesh=mesh,
+                                         encoded=self._encoded)
 
     def rule_fn(self, ruleno: int, result_max: int):
         key = (ruleno, result_max)
@@ -856,26 +897,21 @@ class BatchedMapper:
         """Map a batch: xs uint32[N], weight 16.16 uint32[max_devices]."""
         import time
 
+        if self._plane is not None:
+            return self._plane.map_batch(ruleno, xs, result_max, weight)
         fn = self.rule_fn(ruleno, result_max)
         xs = jnp.asarray(np.asarray(xs, np.uint32))
         weight = jnp.asarray(np.asarray(weight, np.uint32))
         t0 = time.monotonic()
         out = fn(self._arrays, weight, xs)
         dt = time.monotonic() - t0
-        _pc.inc("map_calls")
-        _pc.inc("xs_mapped", int(xs.shape[0]))
         sig = (ruleno, result_max, tuple(xs.shape))
-        if sig not in self._compiled_sigs:
+        first = sig not in self._compiled_sigs
+        if first:
             self._compiled_sigs.add(sig)
-            _pc.inc("jit_compiles")
-            _pc.tinc("jit_compile_time", dt)
-        else:
-            _pc.tinc("map_time", dt)
-            _pc.hist_add("map_lat", dt)
         # device plane: xs + weight cross host->device, the result
         # block (results + lens, i32) crosses back when consumed
-        device_metrics.record_launch(
-            "crush.mapper", sig, dt,
-            h2d_bytes=int(xs.size) * 4 + int(weight.size) * 4,
-            d2h_bytes=int(xs.shape[0]) * (result_max + 1) * 4)
+        book_map_batch(sig, dt, int(xs.shape[0]), result_max, first,
+                       h2d_bytes=int(xs.size) * 4 + int(weight.size) * 4,
+                       d2h_bytes=int(xs.shape[0]) * (result_max + 1) * 4)
         return out

@@ -42,13 +42,20 @@ class _EncodeReq:
 
 
 class EncodeBatcher:
+    """``mesh``: an explicit device mesh threads through to
+    ``ErasureCode.encode_batched`` so a coalesced dispatch shards its
+    stripe batch axis across the chips; None defers to the process
+    default (``parallel.placement.set_data_plane_mesh``), which is
+    itself None — unsharded — unless a daemon installed one."""
+
     def __init__(self, max_delay_us: int = 0,
-                 max_batch: int = MAX_BATCH):
+                 max_batch: int = MAX_BATCH, mesh=None):
         self._mutex = make_lock("ec::batch_leader")
         self._qlock = make_lock("ec::batch_q")
         self._q: List[_EncodeReq] = []
         self._delay = max(0, max_delay_us) / 1e6
         self._max_batch = max(1, max_batch)
+        self._mesh = mesh
 
     def encode(self, code, want_to_encode, raw: bytes) -> Dict:
         """Drop-in for ``code.encode(want, raw)``: queue, then either
@@ -107,7 +114,7 @@ class EncodeBatcher:
             # pad rows cost arithmetic, not compiles, and are dropped
             pad = (1 << (len(raws) - 1).bit_length()) - len(raws)
             raws += [bytes(len(raws[0]))] * pad
-            outs = code.encode_batched(want, raws)
+            outs = code.encode_batched(want, raws, mesh=self._mesh)
             for r, out in zip(part, outs):
                 r.out = out
             book_batch(len(part))

@@ -71,14 +71,36 @@ def book_batch(n_stripes: int) -> None:
     _pc.hist_add("ec_batch_size", n_stripes)
 
 
+def _data_plane_mesh():
+    """The process-default data-plane mesh, when one is installed
+    (parallel.placement.set_data_plane_mesh).  Reads the
+    dependency-free holder, NOT parallel.placement — that module
+    pulls the CRUSH mapper (and its x64 config flip), which
+    plugin-only processes must never pay for on the encode path."""
+    from ..parallel.meshctx import get_mesh
+
+    return get_mesh()
+
+
+def encode_batched_sharded(code: "BitCode", stripes, mesh,
+                           axis_name: str = None):
+    """Module-level handle for ``BitCode.encode_batched_sharded`` —
+    the name the jaxcheck contract registry and the multichip bench
+    lane address the sharded kernel by."""
+    return code.encode_batched_sharded(stripes, mesh,
+                                       axis_name=axis_name)
+
+
 def _account(kind: str, sig: tuple, dt: float, nbytes: int,
-             jitted: bool = True, nbytes_out: int = 0) -> None:
+             jitted: bool = True, nbytes_out: int = 0,
+             device_ids=None) -> None:
     """Shared by every EC execution engine (the jitted bit-plane path
     here and native_gf's table engine, which passes jitted=False —
     it has no compile step to separate out).  Jitted launches also
     book into the device plane: the input bytes cross host->device,
     the materialized output crosses back (common/device_metrics.py,
-    per-shape-signature)."""
+    per-shape-signature).  Mesh launches pass ``device_ids`` so every
+    participating chip books a per-device row too."""
     _pc.inc(f"{kind}_ops")
     _pc.inc(f"{kind}_bytes", nbytes)
     if jitted and sig not in _seen_sigs:
@@ -89,9 +111,14 @@ def _account(kind: str, sig: tuple, dt: float, nbytes: int,
         _pc.tinc(f"{kind}_time", dt)
         _pc.hist_add(f"{kind}_lat", dt)
     if jitted:
-        device_metrics.record_launch(
-            "ec.engine", f"{kind}:{sig}", dt,
-            h2d_bytes=nbytes, d2h_bytes=nbytes_out)
+        if device_ids:
+            device_metrics.record_mesh_launch(
+                "ec.engine", f"{kind}:{sig}", dt, device_ids,
+                h2d_bytes=nbytes, d2h_bytes=nbytes_out)
+        else:
+            device_metrics.record_launch(
+                "ec.engine", f"{kind}:{sig}", dt,
+                h2d_bytes=nbytes, d2h_bytes=nbytes_out)
 
 
 @jax.jit
@@ -204,6 +231,7 @@ class BitCode:
         self.full_bm = full                      # ((k+m)w, kw)
         self._enc_dev = jnp.asarray(self.coding_bm)
         self._dec_cache: Dict[Tuple[int, ...], tuple] = {}
+        self._mesh_cache: Dict[tuple, object] = {}
 
     # -- encode -------------------------------------------------------
     def _fused_w8(self):
@@ -237,7 +265,7 @@ class BitCode:
                  nbytes_out=self.m * int(data.shape[1]))
         return out
 
-    def encode_batched(self, stripes):
+    def encode_batched(self, stripes, mesh=None):
         """u8[B, k, L] -> parity u8[B, m, L]: ONE kernel dispatch for
         B same-shape stripes.
 
@@ -249,7 +277,18 @@ class BitCode:
         callers batching at fixed sizes stay inside the recompile
         budget), and the parities split back.  Byte-identical to B
         per-stripe ``encode`` calls: the matmul is exact integer
-        arithmetic over disjoint columns."""
+        arithmetic over disjoint columns.
+
+        ``mesh``: an explicit ``jax.sharding.Mesh`` — or, when None,
+        the process-default ``parallel.placement.data_plane_mesh()``
+        — with more than one device routes through
+        ``encode_batched_sharded``: the stripe batch axis sharded
+        across the chips, still one launch, still byte-identical."""
+        if mesh is None:
+            mesh = _data_plane_mesh()
+        if mesh is not None and \
+                int(np.asarray(mesh.devices).size) > 1:  # jax-ok: mesh.devices is a host-side numpy array of Device handles
+            return self.encode_batched_sharded(stripes, mesh)
         stripes = jnp.asarray(stripes)
         B, k, L = stripes.shape
         assert k == self.k, (k, self.k)
@@ -270,6 +309,74 @@ class BitCode:
                   pk is not None),
                  time.monotonic() - t0, int(stripes.size),
                  nbytes_out=B * self.m * L)
+        book_batch(B)
+        return out
+
+    def _mesh_fn(self, mesh, axis_name: str):
+        """The jitted stripe-batch-sharded encode for one mesh: the
+        batch axis carries ``NamedSharding(mesh, P(axis))``, every
+        chip encodes its stripe shard against the replicated coding
+        bitmatrix, and no collective ever runs — the DrJAX
+        data-parallel leaf computation with an empty reduce."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        key = (mesh, axis_name)
+        fn = self._mesh_cache.get(key)
+        if fn is None:
+            shard = NamedSharding(mesh, P(axis_name, None, None))
+            layout, enc, m = self.layout, self._enc_dev, self.m
+
+            def one(data):
+                L = data.shape[1]
+                rows = layout.to_rows(data)
+                return layout.from_rows(_mod2_matmul(enc, rows), m, L)
+
+            fn = jax.jit(jax.vmap(one), in_shardings=(shard,),
+                         out_shardings=shard)
+            self._mesh_cache[key] = fn
+        return fn
+
+    def encode_batched_sharded(self, stripes, mesh,
+                               axis_name: str = None):
+        """The mesh path of ``encode_batched``: u8[B, k, L] with the
+        stripe batch axis sharded across ``mesh``'s devices — one pjit
+        launch, parity u8[B, m, L] sharded the same way.
+
+        B is pow2-padded with zero stripes up to a multiple of the
+        mesh size (a zero stripe's parity is zero for every linear
+        code; pad outputs are sliced off), so batch-shape signatures
+        stay inside the recompile budget and non-divisible batches
+        never fork.  Byte-identical to B per-stripe ``encode`` calls:
+        each stripe is encoded by exactly the per-stripe kernel
+        composition, vmapped."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.meshctx import pad_batch
+
+        stripes = jnp.asarray(stripes)
+        B, k, L = stripes.shape
+        assert k == self.k, (k, self.k)
+        self.layout.check(L)
+        axis_name = axis_name or mesh.axis_names[0]
+        n_dev = int(np.asarray(mesh.devices).size)  # jax-ok: mesh.devices is a host-side numpy array of Device handles
+        Bp = pad_batch(B, n_dev)
+        t0 = time.monotonic()
+        if Bp != B:
+            stripes = jnp.concatenate(
+                [stripes, jnp.zeros((Bp - B, k, L), jnp.uint8)],
+                axis=0)
+        sharded = jax.device_put(
+            stripes, NamedSharding(mesh, P(axis_name, None, None)))
+        out = self._mesh_fn(mesh, axis_name)(sharded)
+        if Bp != B:
+            out = out[:B]
+        _account("encode",
+                 ("encb_mesh", self.coding_bm.shape, (Bp, k, L),
+                  self.layout.w, self.layout.packetsize, n_dev),
+                 time.monotonic() - t0, B * k * L,
+                 nbytes_out=B * self.m * L,
+                 device_ids=[int(d.id) for d in
+                             np.asarray(mesh.devices).ravel()])  # jax-ok: mesh.devices is a host-side numpy array of Device handles
         book_batch(B)
         return out
 

@@ -167,8 +167,8 @@ class ErasureCode:
         raise NotImplementedError
 
     def encode_batched(self, want_to_encode: Iterable[int],
-                       raws: Sequence[bytes | np.ndarray]
-                       ) -> List[Dict[int, np.ndarray]]:
+                       raws: Sequence[bytes | np.ndarray],
+                       mesh=None) -> List[Dict[int, np.ndarray]]:
         """Batched full-object encode: one ``encode_chunks`` dispatch
         for B same-size objects, byte-identical to B ``encode`` calls.
 
@@ -180,12 +180,29 @@ class ErasureCode:
         (CLAY: intra-chunk coupling geometry derives from the chunk
         length, so concatenation shifts sub-chunk boundaries) and
         mixed-size batches fall back to the per-object loop — still
-        byte-identical, just unbatched."""
+        byte-identical, just unbatched.
+
+        ``mesh``: a multi-device ``jax.sharding.Mesh`` (explicit, or
+        the process-default data-plane mesh when None) shards the
+        stripe batch axis u8[B, k, L] across the chips via the
+        engine's ``encode_batched_sharded`` — available for plugins
+        whose parity math runs on a single ``BitCode`` (jerasure/isa
+        matrix and packet codes); layered/sub-chunked plugins keep
+        the concat path."""
         raws = list(raws)
         want = set(want_to_encode)
         if len(raws) <= 1 or self.get_sub_chunk_count() != 1 or \
                 len({len(r) for r in raws}) != 1:
             return [self.encode(want, r) for r in raws]
+        if mesh is None:
+            from ..parallel.meshctx import get_mesh
+
+            mesh = get_mesh()
+        code = getattr(self, "_code", None)
+        if mesh is not None and \
+                int(np.asarray(mesh.devices).size) > 1 and \
+                hasattr(code, "encode_batched_sharded"):
+            return self._encode_batched_mesh(want, raws, code, mesh)
         k = self.get_data_chunk_count()
         n = self.get_chunk_count()
         parts = [self.encode_prepare(r) for r in raws]
@@ -202,6 +219,29 @@ class ErasureCode:
             sl = slice(b * L, (b + 1) * L)
             out.append({i: np.asarray(chunks[i])[sl]
                         for i in want if i in chunks})
+        return out
+
+    def _encode_batched_mesh(self, want: Set[int], raws, code,
+                             mesh) -> List[Dict[int, np.ndarray]]:
+        """The mesh half of ``encode_batched``: stack the prepared
+        objects into the stripe batch u8[B, k, L], shard the batch
+        axis across the mesh through the engine, and assemble per-
+        object chunk dicts exactly as ``encode_chunks`` would (parity
+        chunk j lands at ``chunk_index(k + j)``) — byte-identical to
+        the per-object path."""
+        parts = [self.encode_prepare(r) for r in raws]
+        stripes = np.stack(parts)                       # u8[B, k, L]
+        parity = np.asarray(
+            code.encode_batched_sharded(stripes, mesh))  # u8[B, m, L]
+        k = self.get_data_chunk_count()
+        n = self.get_chunk_count()
+        out: List[Dict[int, np.ndarray]] = []
+        for b, data in enumerate(parts):
+            chunks: Dict[int, np.ndarray] = {
+                self.chunk_index(i): data[i] for i in range(k)}
+            for j in range(k, n):
+                chunks[self.chunk_index(j)] = parity[b, j - k]
+            out.append({i: chunks[i] for i in want if i in chunks})
         return out
 
     # -- decode -------------------------------------------------------

@@ -130,11 +130,20 @@ class PoolMapper:
 
     >>> pm = PoolMapper(osdmap, pool_id)
     >>> out = pm.map_all()   # dict of arrays over every PG
+
+    ``mesh``: a ``jax.sharding.Mesh`` shards the PG axis (ps, every
+    per-PG exception-table row, and every output) across the mesh
+    devices — ``map_all`` becomes one pjit launch over all chips, with
+    the OSDMap runtime vectors replicated.  The PG count is pow2-
+    padded to a mesh multiple (pad lanes carry inactive table rows and
+    are sliced off), so non-divisible pools never fork and the compile
+    signature set stays bounded.
     """
 
-    def __init__(self, m: OSDMap, pool_id: int):
+    def __init__(self, m: OSDMap, pool_id: int, mesh=None):
         self.m = m
         self.pool_id = pool_id
+        self.mesh = mesh
         pool = m.pools[pool_id]
         self.pool = pool
         R = pool.size
@@ -319,8 +328,44 @@ class PoolMapper:
             self._trow["ptemp"] = jnp.asarray(tabs.ptemp)
         trow_axes = {k: 0 for k in self._trow}
 
-        self.fn = jax.jit(jax.vmap(
-            single_pg, in_axes=(None, None, None, None, trow_axes, 0)))
+        vmapped = jax.vmap(
+            single_pg, in_axes=(None, None, None, None, trow_axes, 0))
+        if mesh is None:
+            self.fn = jax.jit(vmapped)
+            self._npad = None
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ..parallel.meshctx import pad_batch
+
+            repl = NamedSharding(mesh, PartitionSpec())
+            shard = NamedSharding(mesh,
+                                  PartitionSpec(mesh.axis_names[0]))
+            self.fn = jax.jit(
+                vmapped,
+                in_shardings=(repl, repl, repl, repl,
+                              {k: shard for k in self._trow}, shard),
+                out_shardings=(shard,) * 6)
+            self._npad = pad_batch(
+                pool.pg_num, int(np.asarray(mesh.devices).size))
+            self._pad_trow()
+
+    def _pad_trow(self):
+        """Extend every per-PG table row to the padded PG count with
+        INACTIVE entries (len fields -1, npairs 0, ptemp -1, row
+        contents NONE) — pad lanes execute the same program but engage
+        no exception stage, and their outputs are sliced off."""
+        npad = self._npad
+        inactive = {"upmap_len": -1, "npairs": 0, "temp_len": -1,
+                    "ptemp": -1}
+        for k, v in list(self._trow.items()):
+            n = int(v.shape[0])
+            if n >= npad:
+                continue
+            fill = inactive.get(k, NONE)
+            pad_shape = (npad - n,) + tuple(v.shape[1:])
+            pad = jnp.full(pad_shape, fill, v.dtype)
+            self._trow[k] = jnp.concatenate([v, pad], axis=0)
 
     def refresh_tables(self):
         """Re-lower the exception tables after upmap/pg_temp edits.
@@ -334,7 +379,7 @@ class PoolMapper:
             (getattr(tabs, f) is None) == (getattr(self.tabs, f) is None)
             for f in ("upmap", "pairs", "temp", "ptemp"))
         if not same:
-            self.__init__(self.m, self.pool_id)
+            self.__init__(self.m, self.pool_id, self.mesh)
             return
         self.tabs = tabs
         for k, v in (("upmap", tabs.upmap), ("upmap_len", tabs.upmap_len),
@@ -343,6 +388,8 @@ class PoolMapper:
                      ("ptemp", tabs.ptemp)):
             if v is not None:
                 self._trow[k] = jnp.asarray(v)
+        if self._npad is not None:
+            self._pad_trow()
 
     def runtime_args(self):
         m = self.m
@@ -356,17 +403,25 @@ class PoolMapper:
 
     def map_all(self, weight=None, state=None, paff=None):
         """Map every PG of the pool.  Returns dict of device arrays:
-        up[pg,R], up_len[pg], up_primary[pg], acting*, ..."""
+        up[pg,R], up_len[pg], up_primary[pg], acting*, ...
+
+        On a meshed mapper the launch runs over the padded PG axis
+        sharded across the chips; pad lanes are sliced off host-side
+        before return."""
         w0, s0, p0 = self.runtime_args()
         weight = w0 if weight is None else jnp.asarray(weight)
         state = s0 if state is None else jnp.asarray(state)
         paff = p0 if paff is None else jnp.asarray(paff)
-        ps = jnp.arange(self.pool.pg_num, dtype=jnp.uint32)
+        n = self.pool.pg_num
+        ps = jnp.arange(self._npad or n, dtype=jnp.uint32)
         up, ulen, uprim, acting, alen, aprim = self.fn(
             self.arrays, weight, state, paff, self._trow, ps)
-        return {"up": up, "up_len": ulen, "up_primary": uprim,
-                "acting": acting, "acting_len": alen,
-                "acting_primary": aprim}
+        out = {"up": up, "up_len": ulen, "up_primary": uprim,
+               "acting": acting, "acting_len": alen,
+               "acting_primary": aprim}
+        if self._npad is not None and self._npad != n:
+            out = {k: np.asarray(v)[:n] for k, v in out.items()}
+        return out
 
 
 def _u32i(v):

@@ -1,0 +1,35 @@
+"""Process-default data-plane mesh holder + batch padding arithmetic.
+
+Deliberately dependency-free (no jax, no crush): the EC engine reads
+the default mesh on EVERY ``encode_batched`` call, and plugin-only
+processes (a monitor, a CPU-engine OSD) must not pay the CRUSH
+mapper's import side effects (the x64 config flip) — or any import at
+all — for a data plane they never shard.  ``parallel.placement``
+re-exports everything here under its public names.
+"""
+
+from __future__ import annotations
+
+_mesh = None
+
+
+def set_mesh(mesh) -> None:
+    global _mesh
+    _mesh = mesh
+
+
+def get_mesh():
+    return _mesh
+
+
+def pad_batch(n: int, n_dev: int) -> int:
+    """The padded batch size for ``n`` items over ``n_dev`` devices:
+    next power of two (bounds the compile-signature set to log2 N
+    entries — the recompile-budget contract), raised to a multiple of
+    the mesh size so the shard axis divides evenly (a no-op on pow2
+    meshes).  Pad lanes are masked or zero, never tallied."""
+    n = max(1, int(n))
+    p = 1 << (n - 1).bit_length()
+    if p % n_dev:
+        p = ((p + n_dev - 1) // n_dev) * n_dev
+    return p

@@ -62,16 +62,36 @@ class CrushTester:
     def test_rule(self, ruleno: int, num_rep: int, min_x: int = 0,
                   max_x: int = 1023, pool: Optional[int] = None,
                   scalar: bool = False, native: bool = False,
-                  collect_mappings: bool = False) -> RuleReport:
+                  collect_mappings: bool = False,
+                  mesh=None) -> RuleReport:
+        """``mesh``: a ``jax.sharding.Mesh`` runs the sweep through
+        ``parallel.PlacementPlane`` — ONE pjit launch maps the whole x
+        range across every chip, and the per-device utilization tally
+        comes back as the plane's all-reduced counts instead of a
+        host-side loop (the CrushTester.cc:588-648 stats pass executed
+        on-device)."""
         cmap = self.w.crush
         xs = np.arange(min_x, max_x + 1, dtype=np.uint32)
         if pool is not None:
             xs = np.asarray([hash32_2_int(int(x), pool) for x in xs],
                             np.uint32)  # CrushTester.cc:570-572
+        counts = None
         if scalar:
             results = [crush_do_rule(cmap, ruleno, int(x), num_rep,
                                      self.weights) for x in xs]
             lens = [len(r) for r in results]
+        elif mesh is not None:
+            from ..parallel.placement import PlacementPlane
+
+            plane = PlacementPlane(cmap, mesh=mesh)
+            res, ln, counts = plane.map_batch(
+                ruleno, xs, num_rep,
+                np.asarray(self.weights, np.uint32),
+                gather_stats=True)
+            res, ln = np.asarray(res), np.asarray(ln)
+            counts = np.asarray(counts)
+            results = [list(res[i, :ln[i]]) for i in range(len(xs))]
+            lens = list(ln)
         elif native:
             from ..crush.native import NativeMapper
 
@@ -95,12 +115,21 @@ class CrushTester:
         rep = RuleReport(ruleno, num_rep, min_x, max_x)
         rep.total = len(xs)
         n_dev = cmap.max_devices
-        stored = np.zeros(n_dev, np.int64)
-        for r in results:
-            rep.size_counts[len(r)] = rep.size_counts.get(len(r), 0) + 1
-            for o in r:
-                if 0 <= o < n_dev:
-                    stored[o] += 1
+        if counts is not None:
+            # the plane's all-reduced on-device tally IS the stats
+            # pass — only the size histogram stays host-side
+            stored = counts.astype(np.int64)
+            for r in results:
+                rep.size_counts[len(r)] = \
+                    rep.size_counts.get(len(r), 0) + 1
+        else:
+            stored = np.zeros(n_dev, np.int64)
+            for r in results:
+                rep.size_counts[len(r)] = \
+                    rep.size_counts.get(len(r), 0) + 1
+                for o in r:
+                    if 0 <= o < n_dev:
+                        stored[o] += 1
         rep.device_stored = stored
         # expected: weight-proportional share of all placed replicas
         wv = np.asarray(self.weights[:n_dev], np.float64)

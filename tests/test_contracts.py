@@ -19,10 +19,11 @@ from ceph_tpu.analysis import jaxcheck
 # must carry a contract — deleting one (or forgetting to register a
 # new kernel's) fails here, not silently
 EXPECTED_CONTRACTS = {
-    "ec.engine.mod2_matmul", "ec.engine.encode_batched", "ec.rs_jax",
+    "ec.engine.mod2_matmul", "ec.engine.encode_batched",
+    "ec.engine.encode_batched_sharded", "ec.rs_jax",
     "ec.jerasure", "ec.isa", "ec.lrc", "ec.shec", "ec.clay",
     "ec.native_gf", "ec.pallas", "crush.mapper_jax",
-    "crush.mapper_spec",
+    "crush.mapper_spec", "parallel.sharded_rule_fn",
 }
 
 

@@ -16,12 +16,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ceph_tpu.analysis import jaxcheck
 from ceph_tpu.crush.builder import sample_cluster_map
 from ceph_tpu.crush.map import CrushMap
 from ceph_tpu.crush.mapper_jax import BatchedMapper, build_rule_fn
 from ceph_tpu.crush import mapper_ref
 from ceph_tpu.ec.rs_jax import RSCode
-from ceph_tpu.parallel.placement import (make_mesh, sharded_rule_fn,
+from ceph_tpu.parallel.placement import (PlacementPlane, make_mesh,
+                                         pad_batch, sharded_rule_fn,
                                          utilization)
 
 N_DEV = 8
@@ -118,6 +120,116 @@ def test_sharded_ec_encode_equals_single_device(mesh):
     enc = jax.jit(code.encode, in_shardings=(sh,), out_shardings=sh)
     parity = np.asarray(enc(data_sh))
     assert np.array_equal(parity, single)
+
+
+# -- PlacementPlane: the production mesh-sharded distribution layer --------
+
+@pytest.mark.parametrize("ruleno,numrep", [(0, 3), (0, 5), (1, 3),
+                                           (1, 6)])
+def test_placement_plane_bit_exact_grid(mesh, cmap, ruleno, numrep):
+    """Sharded results/lens/utilization identical to the unsharded
+    ``build_rule_fn`` output across the rule 0/1 (firstn/indep) x R
+    grid — including a batch NOT divisible by the mesh (pad lanes
+    masked out of the tally)."""
+    weight = np.full(cmap.max_devices, 0x10000, np.uint32)
+    weight[3] = 0x8000
+    plane = PlacementPlane(cmap, mesh=mesh)
+    bm = BatchedMapper(cmap)
+    for n in (N_DEV * 8, 100):    # divisible and pad-and-mask
+        xs = np.arange(n, dtype=np.uint32)
+        res, lens, counts = plane.map_batch(ruleno, xs, numrep,
+                                            weight,
+                                            gather_stats=True)
+        res_un, lens_un = bm.map_batch(ruleno, xs, numrep, weight)
+        res_un = np.asarray(res_un)
+        lens_un = np.asarray(lens_un)
+        assert np.array_equal(np.asarray(res), res_un), (ruleno, n)
+        assert np.array_equal(np.asarray(lens), lens_un), (ruleno, n)
+        want = np.zeros(cmap.max_devices, np.int64)
+        for i in range(n):
+            for v in res_un[i, :lens_un[i]]:
+                if 0 <= v < cmap.max_devices:
+                    want[v] += 1
+        assert np.array_equal(np.asarray(counts), want), (ruleno, n)
+
+
+def test_placement_plane_choose_args_bit_exact(mesh):
+    """The choose_args grid point: the golden chooseargs map through
+    the plane == the unsharded mapper with the same choose_args."""
+    import json
+    import pathlib
+
+    d = json.load(open(pathlib.Path(__file__).parent /
+                       "golden/map_tree3_chooseargs.json"))
+    cmap = CrushMap.from_dict(d["map"])
+    cargs = cmap.choose_args.get("golden")
+    assert cargs is not None, "golden chooseargs map lost its args"
+    case = d["cases"][0]
+    n = min(64, case["x1"] - case["x0"])
+    xs = np.arange(case["x0"], case["x0"] + n, dtype=np.uint32)
+    weight = np.asarray(case["weight"], np.uint32)
+
+    plane = PlacementPlane(cmap, choose_args=cargs, mesh=mesh)
+    res, lens = plane.map_batch(case["ruleno"], xs, case["numrep"],
+                                weight)
+    bm = BatchedMapper(cmap, choose_args=cargs)
+    res_un, lens_un = bm.map_batch(case["ruleno"], xs, case["numrep"],
+                                   weight)
+    assert np.array_equal(np.asarray(res), np.asarray(res_un))
+    assert np.array_equal(np.asarray(lens), np.asarray(lens_un))
+    res, lens = np.asarray(res), np.asarray(lens)
+    for i in range(n):
+        assert list(res[i, :lens[i]]) == case["results"][i], f"x={i}"
+
+
+def test_placement_plane_single_device_mesh(cmap):
+    """The degenerate 1-device mesh: same code path, same results —
+    the tier-1 guarantee that nothing forks on single-chip hosts
+    (runs regardless of how many devices the env provides)."""
+    mesh1 = make_mesh(jax.devices()[:1])
+    plane = PlacementPlane(cmap, mesh=mesh1)
+    weight = np.full(cmap.max_devices, 0x10000, np.uint32)
+    xs = np.arange(37, dtype=np.uint32)   # non-pow2, non-divisible
+    res, lens, counts = plane.map_batch(0, xs, 3, weight,
+                                        gather_stats=True)
+    bm = BatchedMapper(cmap)
+    res_un, lens_un = bm.map_batch(0, xs, 3, weight)
+    assert np.array_equal(np.asarray(res), np.asarray(res_un))
+    assert np.array_equal(np.asarray(lens), np.asarray(lens_un))
+    assert int(np.asarray(counts).sum()) == int(
+        np.asarray(lens_un).sum())
+
+
+def test_pad_batch_bounds_signatures():
+    """pow2 padding: every batch size in [1, 4096] lands on one of
+    O(log) padded shapes, all divisible by the mesh size."""
+    for n_dev in (1, 3, 8):
+        pads = {pad_batch(n, n_dev) for n in range(1, 4097)}
+        assert len(pads) <= 14, (n_dev, sorted(pads))
+        assert all(p % n_dev == 0 for p in pads)
+        assert all(pad_batch(n, n_dev) >= n for n in range(1, 4097))
+
+
+def test_placement_plane_recompile_budget(mesh, cmap):
+    """Mesh size changes must not leak compile signatures beyond the
+    pow2-padding budget: after warming a plane per mesh size, every
+    further batch that pads to a warmed shape hits the jit cache —
+    zero new compiles in the steady-state window (the conftest gate
+    fails this test on any violation; the assert is the explicit
+    twin)."""
+    weight = np.full(cmap.max_devices, 0x10000, np.uint32)
+    planes = [PlacementPlane(cmap, mesh=mesh),
+              PlacementPlane(cmap, mesh=make_mesh(jax.devices()[:1]))]
+    for plane in planes:          # warmup: one compile per mesh size
+        plane.map_batch(0, np.arange(64, dtype=np.uint32), 3, weight)
+    base = len(jaxcheck.recompile_violations())
+    with jaxcheck.steady_state("placement.plane.mesh_sizes"):
+        for plane in planes:
+            for n in (64, 40, 33, 64):   # all pad to the warmed 64
+                res, lens = plane.map_batch(
+                    0, np.arange(n, dtype=np.uint32), 3, weight)
+                assert np.asarray(res).shape == (n, 3)
+    assert len(jaxcheck.recompile_violations()) == base
 
 
 def test_golden_map_sharded(mesh):

@@ -48,6 +48,11 @@ METRICS = [
     ("cluster_seq_iops", True),
     ("ec_encode_gbps", True),
     ("ec_batch_speedup", True),
+    ("mc_crush_ndev_s", True),
+    ("mc_crush_eff", True),
+    ("mc_ec_eff", True),
+    ("mc_dry_crush_eff", True),
+    ("mc_dry_ec_eff", True),
     ("init_probe_s", False),
 ]
 
@@ -65,6 +70,42 @@ _INIT_KILL = re.compile(
     r"at t=([\d.]+)s")
 _INIT_HANG_LEGACY = re.compile(
     r"backend never initialized within ([\d.]+)s")
+# the multichip scaling block: BENCH tails carry the bench lane's
+# stage JSON ("# multichip json: {...}"), MULTICHIP dryrun tails carry
+# the dryrun-sized twin ("multichip scaling: {...}")
+_MC_JSON = re.compile(r"multichip (?:json|scaling): (\{.*\})")
+
+
+def _multichip_metrics(tail: str,
+                       dryrun: bool = False) -> Dict[str, float]:
+    """Scaling metrics from a tail's multichip JSON block: the
+    N-device CRUSH throughput and the scaling-efficiency figures
+    (N-device throughput / (N x 1-device)) for CRUSH and EC encode —
+    the ROADMAP item 1 acceptance numbers, red-checked like any other
+    trajectory metric when they drop more than the threshold.
+
+    Dryrun (MULTICHIP_r*) records measure a deliberately smaller
+    workload than the bench lane, so their efficiency lands in its
+    own ``mc_dry_*`` columns — each series deltas like-for-like —
+    and their absolute rate (small-map, incomparable) is dropped."""
+    m = _MC_JSON.search(tail)
+    if not m:
+        return {}
+    try:
+        d = json.loads(m.group(1))
+    except ValueError:
+        return {}
+    pre = "mc_dry_" if dryrun else "mc_"
+    keys = [("crush_scaling_efficiency", pre + "crush_eff"),
+            ("ec_scaling_efficiency", pre + "ec_eff")]
+    if not dryrun:
+        keys.append(("crush_ndev_mappings_per_sec",
+                     "mc_crush_ndev_s"))
+    out: Dict[str, float] = {}
+    for key, name in keys:
+        if isinstance(d.get(key), (int, float)):
+            out[name] = float(d[key])
+    return out
 
 
 def load_run(path: str) -> Optional[Dict]:
@@ -92,6 +133,7 @@ def load_run(path: str) -> Optional[Dict]:
         m = pat.search(tail)
         if m:
             row["metrics"][metric] = float(m.group(1))
+    row["metrics"].update(_multichip_metrics(tail))
     # how long the staged lane burned before the accelerator verdict:
     # the backend-init fail-fast probe should cap this at ~60 s (the
     # r05 run burned 300 s; the probe landed after that measurement)
@@ -109,6 +151,20 @@ def load_run(path: str) -> Optional[Dict]:
     return row
 
 
+def load_multichip(path: str) -> Optional[Dict]:
+    """One MULTICHIP_rNN.json dryrun record: run number + the scaling
+    metrics parsed from its tail (absent on records that predate the
+    scaling block)."""
+    try:
+        raw = json.load(open(path))
+    except (OSError, ValueError) as e:
+        print(f"# {path}: unreadable ({e})", file=sys.stderr)
+        return None
+    return {"ok": raw.get("ok"),
+            "metrics": _multichip_metrics(raw.get("tail") or "",
+                                          dryrun=True)}
+
+
 def load_all(directory: str) -> List[Dict]:
     rows = []
     for path in sorted(glob.glob(os.path.join(directory,
@@ -116,6 +172,28 @@ def load_all(directory: str) -> List[Dict]:
         row = load_run(path)
         if row is not None:
             rows.append(row)
+    by_n = {r["n"]: r for r in rows}
+    # MULTICHIP_rNN dryrun records ride the same trajectory: their
+    # scaling metrics merge into the same-numbered bench row (the
+    # driver emits both per run), creating a standalone row when no
+    # bench run shares the number.  Bench-measured values win — the
+    # dryrun twin is smaller-scale.
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "MULTICHIP_r*.json"))):
+        m = re.search(r"MULTICHIP_r(\d+)\.json$", path)
+        mc = load_multichip(path)
+        if mc is None or m is None or not mc["metrics"]:
+            continue
+        n = int(m.group(1))
+        row = by_n.get(n)
+        if row is None:
+            row = {"run": f"r{n:02d}", "n": n,
+                   "path": os.path.basename(path), "rc": None,
+                   "platform": None, "metrics": {}, "slo_fail": []}
+            by_n[n] = row
+            rows.append(row)
+        for k, v in mc["metrics"].items():
+            row["metrics"].setdefault(k, v)
     rows.sort(key=lambda r: r["n"])
     return rows
 
