@@ -107,6 +107,11 @@ OPTIONS: Dict[str, Option] = _opts(
            "transactions to join before the shared fsync; 0 = no "
            "artificial delay (the group is whatever queued while the "
            "previous fsync ran — the kv_sync_thread dynamics)"),
+    Option("client_retry_deadline", float, 10.0,
+           "total seconds a client op may spend SLEEPING between "
+           "retries (the jittered-backoff budget, common/backoff.py); "
+           "once exhausted the op re-raises its last error instead of "
+           "pacing another attempt"),
     Option("client_aio_window", int, 16,
            "default bounded in-flight window for Client.aio_put / "
            "aio_write (the objecter max-in-flight role): how many "
@@ -136,6 +141,12 @@ OPTIONS: Dict[str, Option] = _opts(
     Option("mon_pool_stats_retention", int, 240,
            "per-pool stat samples retained by the monitor's PGMap "
            "ring (the `pool-stats` rate series)"),
+    Option("fault_inject_spec", str, "",
+           "armed failpoints (analysis/faults.py spec syntax, e.g. "
+           "'msgr.corrupt_frame=p:0.02;osd.slow_op=p:0.1,delay:0.05')"
+           "; empty disarms everything — the ms-inject-socket-"
+           "failures / filestore_debug_inject_read_err surface",
+           level="dev"),
 )
 
 

@@ -35,6 +35,12 @@ class Context:
             from ..analysis import lockdep
 
             lockdep.enable(True)
+        # bind the fault-injection plane to this runtime's config:
+        # applies the current fault_inject_spec and follows runtime
+        # set() live (one observer per shared Config — idempotent)
+        from ..analysis import faults
+
+        faults.install(self.conf)
         self.log = LogCore(max_recent=self.conf["log_max_recent"])
         self.perf = PerfCountersCollection()
         # the daemon's tracing plane (common/tracing.py): services and
@@ -78,6 +84,11 @@ class Context:
             self._admin = AdminSocket(self.admin_socket_path)
             wire_defaults(self._admin, config=self.conf,
                           perf=self.perf, logcore=self.log)
+            # the fault-injection command plane (`fault set|list|
+            # clear` — the `ceph daemon ... injectargs`-era surface)
+            from ..analysis import faults
+
+            faults.wire(self._admin)
             self._admin.start()
             # a daemon with an admin plane gets the stall watchdog
             # behind it: dump_blocked serves on demand, the scanner

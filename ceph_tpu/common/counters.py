@@ -45,6 +45,7 @@ REGISTRY: Dict[str, Dict[str, str]] = {
     "osd": {
         "ops_w": U64,
         "ops_r": U64,
+        "degraded_reads": U64,
         "recovered_objects": U64,
         "recovery_bytes": U64,
         "map_epochs": U64,
@@ -102,6 +103,26 @@ REGISTRY: Dict[str, Dict[str, str]] = {
         "cache_hits": U64,
         "map_time": TIME,
         "map_lat": HIST,
+    },
+    # the fault-injection plane (analysis/faults.py): one firing
+    # counter per failpoint, booked process-globally so a chaos soak
+    # can assert every armed fault actually fired (the names mirror
+    # analysis.faults.FAILPOINTS — keep the two tables in sync)
+    "faults": {
+        "msgr.drop_frame": U64,
+        "msgr.delay_frame": U64,
+        "msgr.dup_frame": U64,
+        "msgr.corrupt_frame": U64,
+        "msgr.close_mid_frame": U64,
+        "os.read_eio": U64,
+        "os.fsync_eio": U64,
+        "os.torn_append": U64,
+        "osd.kill_before_commit": U64,
+        "osd.kill_after_commit": U64,
+        "osd.slow_op": U64,
+        "osd.shard_read_eio": U64,
+        "mon.drop_pg_stats": U64,
+        "mon.isolate_rank": U64,
     },
     # the device plane (common/device_metrics.py): host<->device
     # transfer volume, kernel launch accounting, and live-buffer /

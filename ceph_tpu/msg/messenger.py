@@ -57,8 +57,10 @@ import uuid
 import zlib
 from typing import Callable, Dict, Optional, Tuple
 
+from ..analysis import faults
 from ..analysis import watchdog
 from ..analysis.lockdep import make_lock, make_rlock
+from ..common.backoff import Backoff
 from ..common.encoding import MalformedInput
 from ..common.log import getLogger
 from ..common.perf_counters import PerfCounters
@@ -271,14 +273,22 @@ def decode_frame(payload: bytes) -> Tuple[Dict, list]:
     return msg, blobs
 
 
-def _send_frame(sock: socket.socket, msg: Dict, keyring=None) -> int:
+def _send_frame(sock: socket.socket, msg: Dict, keyring=None,
+                mutate=None) -> int:
     """Queue the frame on the socket's writer and flush — coalescing
     with whatever else is queued — as the writer-lock holder.  Returns
     the wire size (header + payload) for the byte counters; raises the
     send failure on the CALLER's thread even when another thread's
-    flush carried (and failed) this frame."""
+    flush carried (and failed) this frame.
+
+    ``mutate`` (fault injection only) post-processes the framed bytes
+    — flipping or truncating them — INSIDE the writer path, so the
+    damaged frame still serializes correctly against coalesced
+    writers instead of interleaving mid-batch."""
     payload = encode_frame(msg, keyring)
     buf = struct.pack(">I", len(payload)) + payload
+    if mutate is not None:
+        buf = mutate(buf)
     w = _writer_for(sock)
     # uncontended fast path: writer idle, nothing queued — send
     # directly with no completion bookkeeping (the common case; the
@@ -328,6 +338,30 @@ def _send_frame(sock: socket.socket, msg: Dict, keyring=None) -> int:
         _reap_writer(sock)  # dead socket: never strand its entry
         raise op.error
     return len(payload) + 4
+
+
+def _flip_control_byte(buf: bytes) -> bytes:
+    """Fault-injection mutation (msgr.corrupt_frame): XOR the first
+    byte of the frame's control segment.  The control segment is the
+    only region decode_frame ALWAYS integrity-checks (JSON parse /
+    zlib inflate) — a flipped blob byte would pass silently and
+    corrupt stored data, which models a disk fault, not a wire one —
+    so this is guaranteed to surface as MalformedInput + session
+    drop at the receiver."""
+    # layout: [4B outer length][<BBI header = 6B][control body]...
+    pos = 4 + 6
+    if len(buf) <= pos:
+        return buf
+    out = bytearray(buf)
+    out[pos] ^= 0xFF
+    return bytes(out)
+
+
+def _truncate_frame(buf: bytes) -> bytes:
+    """Fault-injection mutation (msgr.close_mid_frame): keep only the
+    first half of the framed bytes — the receiver blocks on the
+    remainder until the injected close EOFs it."""
+    return buf[:max(4, len(buf) // 2)]
 
 
 def _recv_exact(sock: socket.socket, n: int):
@@ -621,7 +655,8 @@ class Messenger:
         still waiting on this session fail NOW (their frames stay
         buffered — a later reconnect replays them and dedup keeps
         exactly-once execution)."""
-        for attempt in range(5):
+        bo = Backoff(base=0.05, cap=0.5, deadline=3.0)
+        for _ in range(8):
             if not self._running:
                 return
             try:
@@ -629,7 +664,8 @@ class Messenger:
                     self._ensure_synced(addr)
                 return
             except (OSError, TimeoutError):
-                time.sleep(0.1 * (attempt + 1))
+                if not bo.sleep():
+                    break
         self._fail_waiters(addr, "peer unreachable after resync")
 
     def _fail_waiters(self, addr: Addr, why: str) -> None:
@@ -652,9 +688,32 @@ class Messenger:
         """Sign-at-wire-time send: frames are stored/buffered unsigned
         (and may hold raw ``bytes`` values); the MAC is computed over
         the lifted control segment + data-segment digests."""
-        n = _send_frame(conn, msg, self.keyring)
+        mutate = None
+        close_after = False
+        if faults._ACTIVE:  # one bool test when nothing is armed
+            if faults.fires("msgr.drop_frame", self.name):
+                # a TCP stream never silently loses a frame — wire
+                # loss manifests as a dead connection (the `ms inject
+                # socket failures` model); the lossless session's
+                # unacked buffer replays through the reconnect
+                self._hard_close(conn)
+                return
+            faults.sleep_if("msgr.delay_frame", self.name)
+            if faults.fires("msgr.corrupt_frame", self.name):
+                mutate = _flip_control_byte
+            elif faults.fires("msgr.close_mid_frame", self.name):
+                mutate = _truncate_frame
+                close_after = True
+        n = _send_frame(conn, msg, self.keyring, mutate=mutate)
         self.pc.inc("bytes_out", n)
         self.pc.inc("frames_out")
+        if faults._ACTIVE and not close_after and \
+                faults.fires("msgr.dup_frame", self.name):
+            # receiver-side seq dedup (or reply-tid idempotence) must
+            # absorb the retransmission
+            _send_frame(conn, msg, self.keyring)
+        if close_after:
+            self._hard_close(conn)
 
     def _dispatch(self, conn: socket.socket, msg: Dict, blobs: list,
                   nbytes: int) -> None:
@@ -762,7 +821,8 @@ class Messenger:
                 except OSError:
                     pass
                 return
-            time.sleep(0.02)
+            time.sleep(0.02)  # fault-ok: bounded 2s poll of the
+            # local duplicate-reply cache, not peer retry pacing
 
     def _pool_submit(self, fn, *args, control: bool = False) -> None:
         with self._pool_lock:
@@ -818,6 +878,13 @@ class Messenger:
                     with watchdog.section(f"{self.name}:{type_}"):
                         try:
                             reply = handler(msg)
+                        except faults.InjectedKill as e:
+                            # a fired kill point: the daemon "died"
+                            # holding this op — no reply, no ack; the
+                            # sender times out and retries, exactly
+                            # the crash image a real kill -9 leaves
+                            sp.set_tag("error", repr(e))
+                            return
                         except Exception as e:
                             sp.set_tag("error", repr(e))
                             reply = {"error": str(e)}

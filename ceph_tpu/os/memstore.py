@@ -14,8 +14,10 @@ superblock+journal stand-in.
 
 from __future__ import annotations
 
+import errno
 from typing import Dict, List, Optional
 
+from ..analysis import faults
 from ..analysis.lockdep import make_rlock
 from ..common import encoding
 from .objectstore import (ObjectStore, Transaction, OP_CLONE, OP_MKCOLL,
@@ -181,6 +183,12 @@ class MemStore(ObjectStore):
     # -- reads --------------------------------------------------------
     def read(self, cid: str, oid: str, offset: int = 0,
              length: int = -1) -> bytes:
+        if faults.fires("os.read_eio"):
+            # the filestore_debug_inject_read_err role: a bad sector
+            # under an object — WALStore delegates reads here, so one
+            # hook covers both store flavors
+            raise OSError(errno.EIO,
+                          f"injected read error: {cid}/{oid}")
         with self._lock:
             o = self._coll.get(cid, {}).get(oid)
             if o is None:

@@ -27,12 +27,14 @@ tails are detected, not replayed.
 
 from __future__ import annotations
 
+import errno
 import os
 import struct
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..analysis import faults
 from ..analysis.lockdep import make_lock, make_rlock
 from ..common.bincode import (DecodeError, Decoder, Encoder, decode_txn,
                               encode_txn)
@@ -318,6 +320,14 @@ class WALStore(ObjectStore):
             #    the visible swap: if the append fails (ENOSPC, EIO)
             #    the store state still equals the journal.
             try:
+                if faults.fires("os.torn_append"):
+                    # the torn-write crash image: half the record
+                    # reaches the log, then the append "dies" — the
+                    # rollback below must cut the torn bytes so they
+                    # can never replay
+                    self._wal_f.write(rec[:max(1, len(rec) // 2)])
+                    self._wal_f.flush()
+                    raise OSError(errno.EIO, "injected torn append")
                 self._wal_f.write(rec)
                 self._wal_f.flush()
             except Exception:
@@ -377,6 +387,11 @@ class WALStore(ObjectStore):
             try:
                 if f is None:
                     raise OSError("store poisoned (journal failure)")
+                if faults.fires("os.fsync_eio"):
+                    # a bad sector under the journal: the store must
+                    # poison itself — memory shows the txns but disk
+                    # cannot prove them (the reference asserts out)
+                    raise OSError(errno.EIO, "injected fsync error")
                 os.fsync(f.fileno())  # conc-ok: the shared group fsync IS the ack point; the sync mutex serializes leaders, appends proceed under the store lock meanwhile
                 err = None
                 break

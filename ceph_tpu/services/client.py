@@ -20,6 +20,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..analysis.lockdep import make_rlock
+from ..common.backoff import Backoff
 from ..common.op_tracker import OpTracker
 from ..common.perf_counters import collection
 from ..common.tracing import Tracer
@@ -248,6 +249,16 @@ class Client(MapFollower):
                     self.pc.tinc("op_time", dt)
         self.pc.inc(f"ops_{kind}")
 
+    def _retry_backoff(self) -> Backoff:
+        """One jittered-backoff budget per op: retry pacing grows
+        decorrelated-exponentially (no retry storms when a primary
+        dies under N clients) and the TOTAL sleep across retries is
+        bounded by ``client_retry_deadline`` — once spent, the op
+        re-raises its last error instead of pacing another attempt."""
+        dl = (self.ctx.conf["client_retry_deadline"]
+              if self.ctx is not None else 10.0)
+        return Backoff(base=0.1, cap=1.0, deadline=dl)
+
     # -- map -----------------------------------------------------------
     def _h_map_update(self, msg: Dict) -> None:
         self._install_map(msg["payload"])
@@ -284,6 +295,7 @@ class Client(MapFollower):
         PG lock (eversion_t at the primary: immune to client clock
         skew) and fans replicas/shards out in parallel."""
         with self._op("put", pool_id, oid) as (_span, op):
+            bo = self._retry_backoff()
             for attempt in range(retries):
                 v = make_version(self.epoch)  # proposal; primary may
                 # bump
@@ -323,7 +335,8 @@ class Client(MapFollower):
                     if attempt + 1 == retries:
                         raise
                     op.mark_event(f"retry {attempt + 1}")
-                    time.sleep(0.3)
+                    if not bo.sleep():
+                        raise  # retry-sleep budget exhausted
                     self.refresh_map()
 
     def get(self, pool_id: int, oid: str, retries: int = 3,
@@ -338,6 +351,7 @@ class Client(MapFollower):
         # retry must never convert into OSError('unreachable') when the
         # miss is definitive — callers branch on ObjectNotFound
         with self._op("get", pool_id, oid) as (_span, op):
+            bo = self._retry_backoff()
             while True:
                 try:
                     pool, ps, up = self._up(pool_id, oid)
@@ -347,15 +361,14 @@ class Client(MapFollower):
                                                      up)
                     return self._read_ec(pool_id, ps, oid, up, code)
                 except ObjectNotFound:
-                    if nf_left <= 0:
+                    if nf_left <= 0 or not bo.sleep():
                         raise
                     nf_left -= 1
                 except (TimeoutError, OSError, KeyError):
-                    if transient_left <= 0:
+                    if transient_left <= 0 or not bo.sleep():
                         raise
                     transient_left -= 1
                 op.mark_event("retry")
-                time.sleep(0.3)
                 self.refresh_map()
 
     def _read_replicated(self, pool_id, ps, oid, up) -> bytes:
@@ -408,6 +421,7 @@ class Client(MapFollower):
         put (last-writer-wins at object granularity, like the
         reference's replicated offset write under a single client)."""
         with self._op("write", pool_id, oid) as (_span, op):
+            bo = self._retry_backoff()
             for attempt in range(retries):
                 try:
                     pool, ps, up = self._up(pool_id, oid)
@@ -453,7 +467,8 @@ class Client(MapFollower):
                     if attempt + 1 == retries:
                         raise
                     op.mark_event(f"retry {attempt + 1}")
-                    time.sleep(0.3)
+                    if not bo.sleep():
+                        raise  # retry-sleep budget exhausted
                     self.refresh_map()
 
     def _first_reachable(self, up):
@@ -539,6 +554,7 @@ class Client(MapFollower):
         (the reference's log-entry DELETE semantics)."""
         v = make_version(self.epoch)
         with self._op("delete", pool_id, oid) as (_span, op):
+            bo = self._retry_backoff()
             for attempt in range(retries):
                 try:
                     pool, ps, up = self._up(pool_id, oid)
@@ -557,7 +573,8 @@ class Client(MapFollower):
                     if attempt + 1 == retries:
                         raise
                     op.mark_event(f"retry {attempt + 1}")
-                    time.sleep(0.3)
+                    if not bo.sleep():
+                        raise  # retry-sleep budget exhausted
                     self.refresh_map()
 
     def _read_ec(self, pool_id, ps, oid, up, code) -> bytes:

@@ -17,6 +17,7 @@ import tempfile
 import time
 from typing import Dict, List, Optional
 
+from ..common.backoff import Backoff
 from ..common.config import Config
 from ..common.context import Context
 from ..crush.wrapper import CrushWrapper
@@ -144,21 +145,26 @@ class MiniCluster:
         # rebind the original rank port so peers and daemons reach it
         # at the address already in their quorum lists (brief retry:
         # the killed listener's socket may still be closing)
-        deadline = time.monotonic() + 5
+        bo = Backoff(base=0.1, cap=0.5, deadline=5.0)
         while True:
             try:
                 mon = self._make_mon(rank,
                                      port=self.mon_addrs[rank][1])
                 break
             except OSError:
-                if time.monotonic() >= deadline:
+                if not bo.sleep():
                     raise
-                time.sleep(0.2)
         if self.n_mons > 1:
             mon.set_peers(rank, self.mon_addrs)
         mon.start()
         self.mons[rank] = mon
         return mon
+
+    def set_faults(self, spec: str) -> None:
+        """Arm (or disarm, spec="") failpoints cluster-wide: every
+        daemon Context shares self.conf, whose ``fault_inject_spec``
+        observer feeds analysis/faults.py live."""
+        self.conf.set("fault_inject_spec", spec)
 
     def mon_command(self, msg: Dict, timeout: float = 10.0) -> Dict:
         """Send a command to the quorum via the shared failover loop."""

@@ -19,6 +19,7 @@ from __future__ import annotations
 import time
 from typing import Dict
 
+from ..common.backoff import Backoff
 from ..common.perf_counters import collection
 from ..osdmap.incremental import Incremental, apply_incremental
 from ..osdmap.osdmap import OSDMap
@@ -47,6 +48,9 @@ def failover_call(msgr, addrs, msg: Dict, timeout: float = 5.0,
     followers (mon_call) and the MiniCluster harness (mon_command)."""
     last: Exception = MonError("no monitors configured")
     n = max(1, len(addrs))
+    # jittered pacing for in-flight elections: N waiting daemons must
+    # not re-probe the quorum in lockstep (common/backoff.py)
+    bo = Backoff(base=0.1, cap=0.5)
     for i in range(max(1, tries) * n):
         addr = addrs[i % n]
         try:
@@ -57,7 +61,7 @@ def failover_call(msgr, addrs, msg: Dict, timeout: float = 5.0,
         err = rep.get("error") if isinstance(rep, dict) else None
         if err in ("no quorum", "no committed map yet"):
             last = MonError(err)
-            time.sleep(0.25)
+            bo.sleep()
             continue
         return rep, tuple(addr)
     raise last
@@ -95,7 +99,7 @@ class MapFollower:
         """Subscribe to EVERY quorum member (each pushes committed
         epochs, so losing one monitor loses no updates) and return the
         newest committed payload; retries through elections."""
-        deadline = time.monotonic() + timeout
+        bo = Backoff(base=0.1, cap=0.5, deadline=timeout)
         while True:
             payload = None
             for addr in self.mon_addrs:
@@ -112,10 +116,10 @@ class MapFollower:
                         payload = rep
             if payload is not None:
                 return payload
-            if time.monotonic() >= deadline:
+            if not bo.sleep():
                 raise TimeoutError(f"{name}: no committed map from "
                                    f"any monitor")
-            time.sleep(0.25)
+
     def _set_extras(self, msg: Dict) -> None:
         """osd address table + EC profiles travel beside the map
         (call under self._lock)."""

@@ -28,6 +28,7 @@ import threading
 import time
 from typing import Deque, Dict, List, Optional, Tuple
 
+from ..analysis import faults
 from ..analysis.lockdep import make_lock, make_rlock
 from ..common import encoding
 from ..common.context import Context
@@ -91,6 +92,7 @@ class Monitor:
         self._ticker: Optional[threading.Thread] = None
         self._running = False
         self.quorum: Optional[Quorum] = None
+        self.rank = 0  # quorum rank (set_peers); 0 standalone
         self.ec_profiles: Dict[str, Dict[str, str]] = {}
         self.pc = ctx.perf.create("mon")
         self.pc.add_u64_counter("epochs")
@@ -159,6 +161,7 @@ class Monitor:
     def set_peers(self, rank: int, addrs: List[Addr]) -> None:
         """Join an N-monitor quorum (call before start()).  ``addrs``
         is the rank-ordered list of every member including self."""
+        self.rank = rank
         self.quorum = Quorum(
             self, rank, addrs,
             lease=self.ctx.conf["mon_lease"],
@@ -617,13 +620,17 @@ class Monitor:
         return {"epoch": self._commit(f"ec profile {msg['name']}")}
 
     _IO_KEYS = ("rd_ops", "rd_bytes", "wr_ops", "wr_bytes",
-                "ec_encode_ops", "ec_encode_bytes")
+                "degraded_reads", "ec_encode_ops", "ec_encode_bytes")
 
     def _h_pg_stats(self, msg: Dict) -> None:
         """One pg_stats beacon.  Io blocks are recorded per reporting
         OSD (EC reads land on every holder, not the primary); PG
         state/recovery only from primary beacons, which also refresh
         the per-PG staleness clock (the STALE_PG_STATS input)."""
+        if faults._ACTIVE and faults.fires("mon.drop_pg_stats",
+                                           f"mon.{self.rank}"):
+            return None  # beacon lost on the floor: staleness clock
+            # keeps ticking toward STALE_PG_STATS
         pgid = (int(msg["pool"]), int(msg["ps"]))
         now = time.monotonic()
         self.pc.inc("pg_stat_reports")
@@ -927,7 +934,8 @@ class Monitor:
         interval = self.ctx.conf["osd_heartbeat_interval"]
         out_interval = self.ctx.conf["mon_osd_down_out_interval"]
         while self._running:
-            time.sleep(interval / 2)
+            time.sleep(interval / 2)  # fault-ok: failure-detection
+            # tick cadence, not retry pacing against a failing peer
             # the stats plane ticks on EVERY member (observability is
             # local state; any mon serves pool-stats/progress/health)
             try:

@@ -36,6 +36,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
+from ..analysis import faults
 from ..analysis.lockdep import make_lock, make_rlock
 from ..common.context import Context
 from ..common.throttle import Throttle
@@ -134,9 +135,9 @@ class OSDService(MapFollower):
         # store commits, not serialize two at a time)
         self.sched = OpScheduler(n_workers=4)
         self.pc = ctx.perf.create(f"osd.{osd_id}")
-        for key in ("ops_w", "ops_r", "recovered_objects",
-                    "recovery_bytes", "map_epochs",
-                    "pg_stat_beacons"):
+        for key in ("ops_w", "ops_r", "degraded_reads",
+                    "recovered_objects", "recovery_bytes",
+                    "map_epochs", "pg_stat_beacons"):
             self.pc.add_u64_counter(key)
         # per-PG cumulative io/recovery counters (the pg_stat_t
         # io/recovery sums): client read/write ops+bytes, EC encode
@@ -281,7 +282,7 @@ class OSDService(MapFollower):
 
     # -- per-PG io/recovery accounting (pg_stat_t sums role) -----------
     _IO_KEYS = ("rd_ops", "rd_bytes", "wr_ops", "wr_bytes",
-                "ec_encode_ops", "ec_encode_bytes")
+                "degraded_reads", "ec_encode_ops", "ec_encode_bytes")
     _RECOVERY_KEYS = ("objects_recovered", "bytes_recovered")
 
     def _account_io(self, pool_id: int, ps: int, **deltas) -> None:
@@ -353,6 +354,15 @@ class OSDService(MapFollower):
     def _do_shard_write(self, msg: Dict) -> Dict:
         from ..ec.stripe import crc32c
 
+        if faults._ACTIVE:  # one bool test when nothing is armed
+            # the slow-disk delay, BEFORE the PG lock: a slow op must
+            # stall itself, not everything queued behind the lock
+            faults.sleep_if("osd.slow_op", f"osd.{self.id}")
+            if faults.fires("osd.kill_before_commit",
+                            f"osd.{self.id}"):
+                # died before the WAL commit: no data, no ack — the
+                # sender's retry must land cleanly
+                raise faults.InjectedKill("before WAL commit")
         cid = pg_cid(msg["pool"], msg["ps"])
         v = msg.get("v") or make_version(self.epoch)
         oid = f"{msg['oid']}.s{msg['shard']}"
@@ -413,6 +423,12 @@ class OSDService(MapFollower):
                 op.mark_event("queued_for_store")
                 self.store.queue_transaction(txn)
             op.mark_event("commit")
+            if faults._ACTIVE and faults.fires(
+                    "osd.kill_after_commit", f"osd.{self.id}"):
+                # died after the WAL commit: data durable, ack lost —
+                # the retry's rewrite must be idempotent (same data,
+                # version floor keeps newer state safe)
+                raise faults.InjectedKill("after WAL commit")
             self.pc.inc("ops_w")
         return {"ok": True, "epoch": self.epoch}
 
@@ -426,9 +442,25 @@ class OSDService(MapFollower):
         with self.optracker.create("osd_op",
                                    f"read {cid}/{oid}"):
             try:
+                if faults.fires("osd.shard_read_eio",
+                                f"osd.{self.id}"):
+                    raise OSError("injected shard read error")
                 data = self.store.read(cid, oid)
             except KeyError:
                 return {"error": "enoent"}
+            except OSError:
+                # a bad sector under a shard (os.read_eio or the
+                # injected arm above): the op must DEGRADE, not fail —
+                # the reader decodes from survivors ("eio" counts as
+                # reachable-but-unusable in the client's shard math),
+                # and the shard is dropped so recovery re-decodes it
+                # (the test-erasure-eio.sh flow)
+                self.pc.inc("degraded_reads")
+                self._account_io(int(msg["pool"]), int(msg["ps"]),
+                                 degraded_reads=1)
+                self._mark_shard_bad(int(msg["pool"]), int(msg["ps"]),
+                                     msg["oid"], msg["shard"])
+                return {"error": "eio"}
             size = self.store.getattr(cid, oid, "size") or b"0"
             ver = self.store.getattr(cid, oid, "v") or b""
             self.pc.inc("ops_r")
@@ -635,6 +667,12 @@ class OSDService(MapFollower):
                 return {"error": f"only {landed} of "
                                  f"{pool.min_size} required replicas "
                                  f"persisted"}
+            if landed < len(targets):
+                # min_size acked (any full replica can serve the
+                # data, unlike EC shards) — but a member missed the
+                # write: re-replicate now, not at the next periodic
+                # recovery pass
+                self._recover_wake.set()
             self.pc.inc("ops_w")
             self._account_io(pool_id, ps, wr_ops=1,
                              wr_bytes=len(data))
@@ -726,7 +764,7 @@ class OSDService(MapFollower):
             # write that readers never see.  Re-stamp past the
             # reported version and redistribute.
             for _restamp in range(3):
-                landed, newest = 0, None
+                landed, newest, failed = 0, None, 0
                 for pos, osd in enumerate(up):
                     if not (osd == self.id or self._alive(osd)):
                         continue  # peering recovers it at version v
@@ -734,6 +772,7 @@ class OSDService(MapFollower):
                                            payloads[pos], size, v,
                                            qos="client")
                     if rep is None or not rep.get("ok"):
+                        failed += 1
                         continue
                     if rep.get("superseded"):
                         newest = max(newest or "",
@@ -743,10 +782,24 @@ class OSDService(MapFollower):
                 if newest is None:
                     break
                 v = bump(newest)
+            if failed:
+                # a reachable member missed its shard: the acked
+                # version is down to (or near) zero erasure margin,
+                # and the in-place overwrite already consumed the
+                # previous version on the positions that DID land.
+                # The reference fails the whole op here (ECBackend
+                # waits out every sub-op) — but it can afford to: its
+                # PG log carries rollback info, so the landed
+                # sub-writes unwind on peering.  Without rollback,
+                # erroring would send the client through retry rounds
+                # that each land MORE in-place partials (every write
+                # during a dead-but-map-up member window fails), and
+                # it is those stacked partials that erase the last
+                # acked version's >= k coverage.  So: ack at >= k,
+                # and wake recovery NOW to re-decode the missing
+                # shard and restore the margin.
+                self._recover_wake.set()
             if landed < k:
-                # an acked write must be durable: fewer than k shards
-                # at v would be acknowledged-but-unreadable data loss
-                # (the peers may be hung yet still map-up)
                 return {"error": f"only {landed} of {k} required "
                                  f"shards persisted"}
             self.pc.inc("ops_w")
@@ -1099,6 +1152,23 @@ class OSDService(MapFollower):
                                {"type": "pg_poke"})
         return {"ok": True}
 
+    def _mark_shard_bad(self, pool_id: int, ps: int, oid: str,
+                        shard: int) -> None:
+        """An unreadable shard is marked for repair: drop it (its
+        bytes can no longer be trusted) and poke the PG's primary so
+        recovery re-decodes it from the survivors — the degraded read
+        already served the client; this closes the loop on the
+        damage."""
+        try:
+            self._h_shard_remove({"pool": pool_id, "ps": ps,
+                                  "oid": oid, "shard": shard})
+        except Exception as e:
+            # best-effort: a failed repair mark leaves the shard for
+            # the next scrub pass, it must not fail the read that
+            # already degraded cleanly
+            self.log.dout(5, f"mark-bad {pool_id}.{ps}/{oid}."
+                             f"s{shard} failed: {e!r}")
+
     def _h_status(self, _msg: Dict) -> Dict:
         with self._lock:
             return {"osd": self.id, "epoch": self.epoch,
@@ -1127,7 +1197,8 @@ class OSDService(MapFollower):
                     self._stat_beacon_pass()
                 except Exception as e:
                     self.log.dout(5, f"stat beacon pass failed: {e}")
-            time.sleep(interval)
+            time.sleep(interval)  # fault-ok: heartbeat cadence, not
+            # retry pacing against a failing peer
 
     # -- recovery (mark-down -> remap -> recover) ----------------------
     def _recover_loop(self) -> None:

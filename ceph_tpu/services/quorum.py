@@ -57,6 +57,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ..analysis import faults
 from ..analysis.lockdep import make_rlock
 
 Addr = Tuple[str, int]
@@ -108,20 +109,20 @@ class Quorum:
         # burst of client commands (the serial lane drains on the
         # messenger's dedicated control pool)
         m = mon.msgr
-        m.register("mon_probe", self._h_probe, ordered=True,
-                   control=True)
-        m.register("mon_propose", self._h_propose, ordered=True,
-                   control=True)
-        m.register("mon_victory", self._h_victory, ordered=True,
-                   control=True)
-        m.register("mon_lease", self._h_lease, ordered=True,
-                   control=True)
-        m.register("mon_fetch", self._h_fetch, ordered=True,
-                   control=True)
-        m.register("mon_accept", self._h_accept, ordered=True,
-                   control=True)
-        m.register("mon_commit", self._h_commit, ordered=True,
-                   control=True)
+        m.register("mon_probe", self._gate(self._h_probe),
+                   ordered=True, control=True)
+        m.register("mon_propose", self._gate(self._h_propose),
+                   ordered=True, control=True)
+        m.register("mon_victory", self._gate(self._h_victory),
+                   ordered=True, control=True)
+        m.register("mon_lease", self._gate(self._h_lease),
+                   ordered=True, control=True)
+        m.register("mon_fetch", self._gate(self._h_fetch),
+                   ordered=True, control=True)
+        m.register("mon_accept", self._gate(self._h_accept),
+                   ordered=True, control=True)
+        m.register("mon_commit", self._gate(self._h_commit),
+                   ordered=True, control=True)
 
         # restore the promise + staged entry a crash may have left
         # (Paxos.cc reads accepted_pn / uncommitted from the store).
@@ -185,7 +186,8 @@ class Quorum:
                 self._tick()
             except Exception as e:  # a tick must never kill the thread
                 self.mon.log.derr(f"quorum tick: {e!r}")
-            time.sleep(self.lease / 3)
+            time.sleep(self.lease / 3)  # fault-ok: election tick
+            # cadence, not retry pacing against a failing peer
 
     def _tick(self) -> None:
         now = time.monotonic()
@@ -228,6 +230,22 @@ class Quorum:
                 self._start_election()
         elif state == ELECTING and due:
             self._start_election()
+
+    def _gate(self, handler):
+        """Fault-injection door on every inbound mon-to-mon frame:
+        when ``mon.isolate_rank`` fires for this rank the frame is
+        swallowed — no reply, no ack (InjectedKill semantics in the
+        messenger) — so peers see a partitioned monitor, not an
+        error-returning one."""
+
+        def h(msg: Dict):
+            if faults._ACTIVE and faults.fires(
+                    "mon.isolate_rank", f"mon.{self.rank}"):
+                raise faults.InjectedKill(
+                    f"mon.{self.rank} isolated")
+            return handler(msg)
+
+        return h
 
     # -- probe (rejoin without deposing) ----------------------------------
     def _h_probe(self, _msg: Dict) -> Dict:

@@ -19,6 +19,13 @@ import warnings
 
 import pytest
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long soaks excluded from the tier-1 lane "
+        "(-m 'not slow'); run explicitly with -m slow")
+
 # lockdep on for the WHOLE suite (overridable with CEPH_TPU_LOCKDEP=0):
 # every test inherits the lock-order checker, so a future PR that
 # introduces an inversion fails its own tests with both witness

@@ -1,0 +1,583 @@
+"""Fault-injection plane tests (analysis/faults.py and its hook sites).
+
+The contract under test, layer by layer:
+
+  * registry — spec parsing fails loudly on typos, arms fire with
+    their declared semantics (p / count / oneshot / who-scoping),
+    seeded probability draws are reproducible, and an unarmed plane
+    is one bool test (``_ACTIVE``) on every hot path.
+  * arming doors — the config observer (``fault_inject_spec``) and
+    the admin-socket ``fault`` command drive the same armed set.
+  * messenger — wire faults (corrupt / truncate / drop / dup /
+    delay) surface as MalformedInput + clean session reset at the
+    receiver, and the lossless session's replay carries the op
+    through: no hang, no lost ack.
+  * stores — WAL torn appends roll back to a record boundary and the
+    store stays usable; a journal fsync EIO poisons it (the
+    reference asserts out for the same reason); objectstore read EIO
+    is a one-op event.
+  * osd — write-pipeline kill points on a replica leave the op
+    ackable via min_size; a shard read EIO degrades (decode from
+    survivors), books ``degraded_reads``, and recovery re-decodes
+    the dropped shard.
+  * monitor — dropped pg_stats beacons and rank isolation fire and
+    heal.
+  * the seeded thrasher soak (tools/thrasher.py) ends HEALTH_OK with
+    zero acked-write loss while every armed failpoint fired.
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+import pytest
+
+from ceph_tpu.analysis import faults
+from ceph_tpu.common.admin_socket import AdminSocket
+from ceph_tpu.common.backoff import Backoff
+from ceph_tpu.common.config import Config
+from ceph_tpu.common.context import Context
+from ceph_tpu.common.encoding import MalformedInput
+from ceph_tpu.msg.messenger import Messenger, _flip_control_byte, \
+    decode_frame, encode_frame
+from ceph_tpu.os.memstore import MemStore
+from ceph_tpu.os.objectstore import Transaction
+from ceph_tpu.os.wal_store import WALStore
+from ceph_tpu.services.cluster import MiniCluster
+from ceph_tpu.services.osd_service import pg_cid
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+from tools import perf_history, thrasher  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    """Every test starts and ends with nothing armed and zeroed
+    totals — the plane is process-global state."""
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _fast_conf():
+    c = Config()
+    c.set("osd_heartbeat_interval", 0.2)
+    c.set("osd_heartbeat_grace", 1.2)
+    c.set("mon_osd_down_out_interval", 1.5)
+    c.set("osd_pg_stat_report_interval", 0.2)
+    return c
+
+
+# -- registry ---------------------------------------------------------
+def test_parse_spec_rejects_unknown_name_and_arm():
+    with pytest.raises(ValueError):
+        faults.parse_spec("msgr.eat_frame=oneshot")
+    with pytest.raises(ValueError):
+        faults.parse_spec("msgr.drop_frame=always")
+    with pytest.raises(ValueError):
+        faults.parse_spec("msgr.drop_frame")
+    with pytest.raises(ValueError):
+        faults.parse_spec("osd.slow_op=oneshot,delay 0.1")
+
+
+def test_parse_spec_multi_failpoint_with_extras():
+    fps = faults.parse_spec(
+        "msgr.corrupt_frame=p:0.25; "
+        "osd.slow_op=count:3,delay:0.5,who:osd.1")
+    assert fps["msgr.corrupt_frame"].mode == "p"
+    assert fps["msgr.corrupt_frame"].p == 0.25
+    assert fps["osd.slow_op"].remaining == 3
+    assert fps["osd.slow_op"].extras == {"delay": "0.5",
+                                         "who": "osd.1"}
+
+
+def test_oneshot_fires_exactly_once():
+    assert not faults.fires("msgr.drop_frame")  # unarmed
+    faults.arm("msgr.drop_frame", "oneshot")
+    assert faults.fires("msgr.drop_frame")
+    assert not faults.fires("msgr.drop_frame")
+    assert faults.snapshot() == {"msgr.drop_frame": 1}
+    assert not faults._ACTIVE  # spent arm disarmed the plane
+
+
+def test_count_arm_decrements_then_disarms():
+    faults.arm("os.read_eio", "count", count=3)
+    assert sum(faults.fires("os.read_eio") for _ in range(10)) == 3
+    assert faults.snapshot()["os.read_eio"] == 3
+
+
+def test_probability_arm_is_seed_deterministic():
+    def draws():
+        faults.seed(42)
+        faults.arm("msgr.dup_frame", "p", p=0.5)
+        out = [faults.fires("msgr.dup_frame") for _ in range(64)]
+        faults.clear()
+        return out
+
+    a, b = draws(), draws()
+    assert a == b
+    assert 5 < sum(a) < 60  # actually probabilistic, not 0%/100%
+
+
+def test_who_prefix_scoping():
+    faults.arm("osd.slow_op", "count", count=100, who="osd.1")
+    assert not faults.fires("osd.slow_op", "osd.2")
+    assert not faults.fires("osd.slow_op", "osd.22")
+    assert not faults.fires("osd.slow_op")  # anonymous site
+    assert faults.fires("osd.slow_op", "osd.1")
+    faults.clear()
+    faults.arm("osd.slow_op", "count", count=100, who="osd")
+    assert faults.fires("osd.slow_op", "osd.7")  # prefix match
+
+
+def test_apply_spec_replaces_and_empty_disarms():
+    faults.arm("msgr.drop_frame", "oneshot")
+    faults.apply_spec("os.read_eio=count:2")
+    armed = faults.list_faults()["armed"]
+    assert set(armed) == {"os.read_eio"}  # replaced, not merged
+    faults.apply_spec("")
+    assert not faults.list_faults()["armed"]
+    assert not faults._ACTIVE
+
+
+def test_clear_keeps_totals_reset_zeroes():
+    faults.arm("msgr.drop_frame", "count", count=5)
+    faults.fires("msgr.drop_frame")
+    faults.clear()
+    assert faults.snapshot() == {"msgr.drop_frame": 1}
+    faults.reset()
+    assert faults.snapshot() == {}
+
+
+def test_extra_and_sleep_if_delay():
+    faults.arm("osd.slow_op", "oneshot", delay="0.15")
+    assert faults.extra("osd.slow_op", "delay", 0.0) == 0.15
+    t0 = time.monotonic()
+    assert faults.sleep_if("osd.slow_op")
+    assert time.monotonic() - t0 >= 0.12
+    assert not faults.sleep_if("osd.slow_op")  # spent
+
+
+# -- arming doors -----------------------------------------------------
+def test_config_observer_arms_and_disarms():
+    conf = Config()
+    faults.install(conf)
+    conf.set("fault_inject_spec", "msgr.dup_frame=oneshot")
+    assert set(faults.list_faults()["armed"]) == {"msgr.dup_frame"}
+    conf.set("fault_inject_spec", "")
+    assert not faults.list_faults()["armed"]
+
+
+def test_admin_socket_fault_command(tmp_path):
+    ctx = Context("osd.77", admin_dir=str(tmp_path))
+    ctx.start_admin_socket()
+    try:
+        rep = AdminSocket.request(
+            ctx.admin_socket_path, "fault", mode="set",
+            spec="osd.slow_op=count:3,delay:0.01")
+        assert rep["armed"]["osd.slow_op"]["mode"] == "count"
+        assert faults.fires("osd.slow_op")  # in-process: same plane
+        rep = AdminSocket.request(ctx.admin_socket_path, "fault",
+                                  mode="list")
+        assert rep["fired"].get("osd.slow_op") == 1
+        rep = AdminSocket.request(ctx.admin_socket_path, "fault",
+                                  mode="clear")
+        assert not rep["armed"]
+        assert not faults.fires("osd.slow_op")
+    finally:
+        ctx.shutdown()
+
+
+# -- backoff ----------------------------------------------------------
+def test_backoff_intervals_jittered_and_capped():
+    bo = Backoff(base=0.05, cap=0.2)
+    prev = 0.0
+    for _ in range(50):
+        iv = bo.next_interval()
+        assert 0.05 <= iv <= 0.2
+        prev = max(prev, iv)
+    assert prev > 0.05  # jitter actually moved off the base
+
+
+def test_backoff_deadline_budget_bounds_total_sleep():
+    bo = Backoff(base=0.01, cap=0.02, deadline=0.08)
+    t0 = time.monotonic()
+    n = 0
+    while bo.sleep():
+        n += 1
+        assert n < 100, "budget never expired"
+    spent = time.monotonic() - t0
+    assert spent < 0.5  # budget + one interval of slop, not unbounded
+    assert bo.expired()
+    assert bo.remaining() == 0.0
+    assert not bo.sleep()  # stays refused once spent
+
+
+def test_backoff_unbudgeted_never_expires():
+    bo = Backoff(base=0.001, cap=0.002)
+    assert bo.remaining() == float("inf")
+    for _ in range(5):
+        assert bo.sleep()
+    assert not bo.expired()
+
+
+# -- messenger wire faults --------------------------------------------
+def _mk_pair(lossless=True):
+    server = Messenger("server", lossless=lossless)
+    client = Messenger("client-side", lossless=lossless)
+    server.start()
+    client.start()
+    return server, client
+
+
+def test_flipped_control_byte_is_malformed_input():
+    payload = encode_frame({"type": "op", "n": 7, "blob": b"\x00" * 32})
+    framed = b"\x00\x00\x00\x00" + payload  # outer length word slot
+    mutated = _flip_control_byte(framed)[4:]
+    assert mutated != payload
+    with pytest.raises(MalformedInput):
+        decode_frame(mutated)
+    decode_frame(payload)  # the unmutated twin still parses
+
+
+def test_corrupt_frame_on_live_connection_resets_and_replays():
+    """The satellite's headline: a corrupted frame mid-session is a
+    clean MalformedInput reset at the receiver — never a wedged
+    reader — and the lossless replay still lands the op."""
+    server, client = _mk_pair()
+    try:
+        server.register("op", lambda m: {"ok": True, "n": m["n"]})
+        assert client.call(server.addr, {"type": "op", "n": 0},
+                           timeout=10)["n"] == 0  # warm session
+        faults.arm("msgr.corrupt_frame", "oneshot", who="client-side")
+        t0 = time.monotonic()
+        rep = client.call(server.addr, {"type": "op", "n": 1},
+                          timeout=20)
+        assert rep["n"] == 1  # replayed uncorrupted after the reset
+        assert time.monotonic() - t0 < 15
+        assert faults.snapshot()["msgr.corrupt_frame"] == 1
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_corrupt_frame_lossy_session_fails_fast_then_recovers():
+    """On a lossy (client-like) session there is no replay: the op
+    must fail FAST when the session dies — not hang to timeout — and
+    the next op gets a fresh session."""
+    server, client = _mk_pair(lossless=False)
+    try:
+        server.register("op", lambda m: {"ok": True, "n": m["n"]})
+        assert client.call(server.addr, {"type": "op", "n": 0},
+                           timeout=10)["n"] == 0
+        faults.arm("msgr.corrupt_frame", "oneshot", who="client-side")
+        t0 = time.monotonic()
+        with pytest.raises((OSError, TimeoutError)):
+            client.call(server.addr, {"type": "op", "n": 1},
+                        timeout=30)
+        assert time.monotonic() - t0 < 20, \
+            "corrupted frame wedged the call instead of failing fast"
+        rep = client.call(server.addr, {"type": "op", "n": 2},
+                          timeout=10)
+        assert rep["n"] == 2
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_close_mid_frame_replays_through_reconnect():
+    server, client = _mk_pair()
+    try:
+        server.register("op", lambda m: {"ok": True, "n": m["n"]})
+        assert client.call(server.addr, {"type": "op", "n": 0},
+                           timeout=10)["n"] == 0
+        faults.arm("msgr.close_mid_frame", "oneshot",
+                   who="client-side")
+        rep = client.call(server.addr, {"type": "op", "n": 1},
+                          timeout=20)
+        assert rep["n"] == 1
+        assert faults.snapshot()["msgr.close_mid_frame"] == 1
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_drop_frame_lossless_replay_recovers():
+    server, client = _mk_pair()
+    try:
+        server.register("op", lambda m: {"ok": True, "n": m["n"]})
+        assert client.call(server.addr, {"type": "op", "n": 0},
+                           timeout=10)["n"] == 0
+        faults.arm("msgr.drop_frame", "oneshot", who="client-side")
+        rep = client.call(server.addr, {"type": "op", "n": 1},
+                          timeout=20)
+        assert rep["n"] == 1
+        assert faults.snapshot()["msgr.drop_frame"] == 1
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_dup_frame_absorbed_by_dedup():
+    server, client = _mk_pair()
+    seen = []
+    try:
+        server.register("op",
+                        lambda m: (seen.append(m["n"]),
+                                   {"ok": True, "n": m["n"]})[1])
+        assert client.call(server.addr, {"type": "op", "n": 0},
+                           timeout=10)["n"] == 0
+        faults.arm("msgr.dup_frame", "oneshot", who="client-side")
+        rep = client.call(server.addr, {"type": "op", "n": 1},
+                          timeout=20)
+        assert rep["n"] == 1
+        assert faults.snapshot()["msgr.dup_frame"] == 1
+        time.sleep(0.3)  # give a re-executed dup time to surface
+        assert seen.count(1) == 1, f"dup re-executed: {seen}"
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_delay_frame_injects_latency():
+    server, client = _mk_pair()
+    try:
+        server.register("op", lambda m: {"ok": True})
+        assert client.call(server.addr, {"type": "op", "n": 0},
+                           timeout=10)["ok"]
+        faults.arm("msgr.delay_frame", "oneshot", who="client-side",
+                   delay="0.3")
+        t0 = time.monotonic()
+        assert client.call(server.addr, {"type": "op", "n": 1},
+                           timeout=10)["ok"]
+        assert time.monotonic() - t0 >= 0.25
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+# -- objectstore / WAL faults -----------------------------------------
+def test_memstore_read_eio_is_one_op():
+    st = MemStore()
+    st.queue_transaction(
+        Transaction().create_collection("pg1").write(
+            "pg1", "a", 0, b"hello"))
+    faults.arm("os.read_eio", "oneshot")
+    with pytest.raises(OSError):
+        st.read("pg1", "a")
+    assert st.read("pg1", "a") == b"hello"  # transient, not sticky
+
+
+def test_wal_torn_append_rolls_back_and_store_survives(tmp_path):
+    st = WALStore(str(tmp_path / "s"))
+    st.mkfs()
+    st.mount()
+    st.queue_transaction(
+        Transaction().create_collection("pg1").write(
+            "pg1", "a", 0, b"good"))
+    faults.arm("os.torn_append", "oneshot")
+    with pytest.raises(OSError):
+        st.queue_transaction(
+            Transaction().write("pg1", "torn", 0, b"x" * 512))
+    # the rollback cut the torn bytes: the store keeps serving and
+    # journaling, and the failed txn never became visible
+    with pytest.raises(KeyError):
+        st.read("pg1", "torn")
+    st.queue_transaction(
+        Transaction().write("pg1", "b", 0, b"after"))
+    assert st.read("pg1", "b") == b"after"
+    # crash image: a fresh mount replays only the good records
+    st2 = WALStore(st.path)
+    st2.mount()
+    assert st2.read("pg1", "a") == b"good"
+    assert st2.read("pg1", "b") == b"after"
+    with pytest.raises(KeyError):
+        st2.read("pg1", "torn")
+    st2.umount()
+    st.umount()
+
+
+def test_wal_fsync_eio_poisons_store(tmp_path):
+    st = WALStore(str(tmp_path / "s"))
+    st.mkfs()
+    st.mount()
+    faults.arm("os.fsync_eio", "oneshot")
+    with pytest.raises(OSError):
+        st.queue_transaction(
+            Transaction().create_collection("pg1").write(
+                "pg1", "a", 0, b"x"))
+    # the journal cannot prove durability anymore: the store must
+    # refuse every later write, not limp along un-journaled
+    with pytest.raises((OSError, AssertionError)):
+        st.queue_transaction(
+            Transaction().create_collection("pg2"))
+
+
+# -- osd write-pipeline / degraded reads ------------------------------
+def test_replica_kill_points_op_still_acks():
+    """A replica dying before OR after its WAL commit must not fail
+    the client op: min_size acks carry it, and the data reads back."""
+    c = MiniCluster(n_osds=3, hosts=3, config=_fast_conf()).start()
+    try:
+        c.create_replicated_pool(1, pg_num=8, size=3)
+        cli = c.client("killpoints")
+        for name, oid, val in (("osd.kill_before_commit", "kb",
+                                b"alpha"),
+                               ("osd.kill_after_commit", "ka",
+                                b"beta")):
+            _pool, _ps, up = cli._up(1, oid)
+            faults.arm(name, "oneshot", who=f"osd.{up[1]}")
+            cli.put(1, oid, val)  # acks via the surviving min_size
+            assert faults.snapshot()[name] == 1
+            assert cli.get(1, oid) == val
+    finally:
+        c.shutdown()
+
+
+def test_degraded_ec_read_decodes_counts_and_repairs():
+    """A shard read EIO degrades instead of failing: the client
+    decodes from survivors, the holder books ``degraded_reads`` (perf
+    counter AND pool-stats), and recovery re-decodes the shard."""
+    c = MiniCluster(n_osds=4, hosts=4, config=_fast_conf()).start()
+    try:
+        c.create_ec_pool(2, "flt21",
+                         {"plugin": "jerasure",
+                          "technique": "reed_sol_van",
+                          "k": "2", "m": "1", "w": "8"}, pg_num=8)
+        cli = c.client("degraded")
+        data = bytes(range(256)) * 8
+        cli.put(2, "degobj", data)
+        _pool, ps, up = cli._up(2, "degobj")
+        victim = up[0]  # shard 0's holder: first probed on read
+        faults.arm("osd.shard_read_eio", "oneshot",
+                   who=f"osd.{victim}")
+        assert cli.get(2, "degobj") == data  # decoded from survivors
+        assert faults.snapshot()["osd.shard_read_eio"] == 1
+        svc = c.osds[victim]
+        assert svc.pc.dump().get("degraded_reads", 0) >= 1
+        # the bad shard was dropped for repair: recovery re-decodes it
+        cid = pg_cid(2, ps)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if svc.store.collection_exists(cid) and \
+                    svc.store.stat(cid, "degobj.s0") is not None:
+                break
+            time.sleep(0.1)
+        assert svc.store.stat(cid, "degobj.s0") is not None, \
+            "EIO'd shard never repaired"
+        # the accounting reaches the monitor's pool-stats surface
+        deadline = time.monotonic() + 20.0
+        got = 0
+        while time.monotonic() < deadline:
+            cur = c.pool_stats(2)["pools"].get("2", {}).get(
+                "current", {})
+            got = cur.get("degraded_reads", 0)
+            if got >= 1:
+                break
+            time.sleep(0.2)
+        assert got >= 1, "degraded_reads never surfaced in pool-stats"
+    finally:
+        c.shutdown()
+
+
+# -- client retry pacing ----------------------------------------------
+def test_client_retry_deadline_bounds_retry_storm():
+    """The regression the backoff budget exists for: with every OSD
+    dead, put(retries=1000) must give up when the SLEEP budget is
+    spent — seconds — not pace out 1000 fixed sleeps."""
+    c = MiniCluster(n_osds=3, hosts=3).start()  # default (slow)
+    # failure detection: the map keeps the dead OSDs "up", so every
+    # attempt fails at the transport and the retry loop is the only
+    # thing between the client and a 1000-sleep stall
+    try:
+        c.create_replicated_pool(1, pg_num=8, size=3)
+        cli = c.client("deadline")
+        cli.put(1, "warm", b"x")
+        c.conf.set("client_retry_deadline", 0.5)
+        for o in list(c.osds):
+            c.kill_osd(o)
+        t0 = time.monotonic()
+        with pytest.raises((OSError, TimeoutError, KeyError)):
+            cli.put(1, "unreachable", b"y", retries=1000)
+        assert time.monotonic() - t0 < 30, \
+            "retry loop ignored the sleep budget"
+    finally:
+        c.shutdown()
+
+
+# -- monitor faults ---------------------------------------------------
+def test_mon_drop_pg_stats_fires_and_health_recovers():
+    c = MiniCluster(n_osds=2, hosts=2, config=_fast_conf()).start()
+    try:
+        c.create_replicated_pool(1, pg_num=4, size=2)
+        faults.arm("mon.drop_pg_stats", "count", count=3)
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and \
+                faults.snapshot().get("mon.drop_pg_stats", 0) < 3:
+            time.sleep(0.1)
+        assert faults.snapshot().get("mon.drop_pg_stats", 0) >= 3
+        faults.clear()
+        c.wait_for_health_ok(timeout=20.0)
+    finally:
+        c.shutdown()
+
+
+def test_mon_isolate_rank_fires_and_quorum_serves():
+    conf = _fast_conf()
+    conf.set("mon_lease", 0.3)
+    conf.set("mon_election_timeout", 0.5)
+    c = MiniCluster(n_osds=2, hosts=2, config=conf,
+                    n_mons=3).start()
+    try:
+        c.create_replicated_pool(1, pg_num=4, size=2)
+        faults.arm("mon.isolate_rank", "count", count=30,
+                   who="mon.2")
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline and \
+                not faults.snapshot().get("mon.isolate_rank"):
+            time.sleep(0.1)
+        assert faults.snapshot().get("mon.isolate_rank", 0) >= 1
+        faults.clear()
+        # the surviving majority (and, after healing, all three)
+        # still serves commands
+        assert "status" in c.health()
+        c.wait_for_health_ok(timeout=20.0)
+    finally:
+        c.shutdown()
+
+
+# -- the seeded chaos soak --------------------------------------------
+def test_thrasher_smoke_seeded():
+    """The tier-1 chaos gate: a short seeded soak with the full
+    default fault spec armed must end with zero acked-write loss,
+    HEALTH_OK, clean lockdep/span planes, and every armed failpoint
+    actually fired (rec["ok"] folds all of it)."""
+    rec = thrasher.soak(seed=8, duration=3.0, n_osds=4,
+                        settle_timeout=45.0)
+    assert rec["ok"], rec
+    assert rec["ops"] > 0
+    assert rec["fired"], "no failpoint ever fired under the spec"
+
+
+@pytest.mark.slow
+def test_thrasher_full_soak():
+    """The full soak (CI's -m slow lane): longer, more daemons, a
+    thrashed 3-monitor quorum."""
+    rec = thrasher.soak(seed=8, duration=15.0, n_osds=5, n_mons=3,
+                        settle_timeout=90.0)
+    assert rec["ok"], rec
+
+
+def test_perf_history_ingests_chaos_records(tmp_path):
+    (tmp_path / "CHAOS_r01.json").write_text(json.dumps(
+        {"kind": "chaos", "seed": 8, "ops": 120, "lost": 0,
+         "health_converge_s": 1.2, "ok": True}))
+    assert perf_history.main([str(tmp_path), "--check"]) == 0
+    rows = perf_history.load_all(str(tmp_path))
+    assert rows[-1]["metrics"]["chaos_ops"] == 120.0
+    # lost acked writes are a regression outright, no threshold
+    (tmp_path / "CHAOS_r02.json").write_text(json.dumps(
+        {"kind": "chaos", "seed": 9, "ops": 118, "lost": 2,
+         "health_converge_s": 1.0, "ok": False}))
+    assert perf_history.main([str(tmp_path), "--check"]) == 1

@@ -691,3 +691,142 @@ def test_obs_cli_exit_status(tmp_path):
         [sys.executable, str(REPO / "tools" / "lint_obs.py"),
          str(good)], capture_output=True, text=True)
     assert p.returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# retry-pacing lint (tools/lint_faults.py, FAULT001)
+# ---------------------------------------------------------------------------
+
+def _flint(tmp_path, source, name="mod.py"):
+    from tools import lint_faults
+
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return lint_faults.lint_file(f)
+
+
+def test_repo_is_fault_clean():
+    """No fixed-interval retry pacing anywhere in ceph_tpu/ or
+    tools/: retries go through common/backoff.py (jittered +
+    deadline-budgeted) or carry an explicit # fault-ok: reason."""
+    from tools import lint_faults
+
+    violations = lint_faults.lint_paths([REPO / "ceph_tpu",
+                                         REPO / "tools"])
+    assert not violations, "\n".join(str(v) for v in violations)
+
+
+def test_fault001_sleep_in_retry_loop_flagged(tmp_path):
+    vs = _flint(tmp_path, """
+        import time
+
+        def fetch(call):
+            for attempt in range(3):
+                try:
+                    return call()
+                except OSError:
+                    time.sleep(0.3)
+    """)
+    assert [v.code for v in vs] == ["FAULT001"]
+
+
+def test_fault001_while_retry_loop_flagged(tmp_path):
+    vs = _flint(tmp_path, """
+        import time
+
+        def follow(call):
+            while True:
+                try:
+                    return call()
+                except (OSError, TimeoutError):
+                    pass
+                time.sleep(0.25)
+    """)
+    assert [v.code for v in vs] == ["FAULT001"]
+
+
+def test_fault001_poll_loop_without_except_ok(tmp_path):
+    # waiting on local state is not retry pacing — nothing to storm
+    vs = _flint(tmp_path, """
+        import time
+
+        def wait(done):
+            while not done():
+                time.sleep(0.1)
+    """)
+    assert vs == []
+
+
+def test_fault001_backoff_sleep_ok(tmp_path):
+    vs = _flint(tmp_path, """
+        from ceph_tpu.common.backoff import Backoff
+
+        def fetch(call):
+            bo = Backoff(base=0.1, deadline=5.0)
+            while True:
+                try:
+                    return call()
+                except OSError:
+                    if not bo.sleep():
+                        raise
+    """)
+    assert vs == []
+
+
+def test_fault001_nested_def_not_flagged(tmp_path):
+    # a sleep inside an inner callback is a fresh frame, not paced
+    # by the outer retry loop
+    vs = _flint(tmp_path, """
+        import time
+
+        def outer(call, spawn):
+            for attempt in range(3):
+                try:
+                    def cb():
+                        time.sleep(1.0)
+                    return spawn(cb)
+                except OSError:
+                    pass
+    """)
+    assert vs == []
+
+
+def test_fault001_suppression(tmp_path):
+    vs = _flint(tmp_path, """
+        import time
+
+        def tick(call):
+            while True:
+                try:
+                    call()
+                except OSError:
+                    pass
+                time.sleep(1.0)  # fault-ok: tick cadence, not retries
+    """)
+    assert vs == []
+
+
+def test_fault_cli_exit_status(tmp_path):
+    import subprocess
+    import sys
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import time\n"
+        "def f(c):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return c()\n"
+        "        except OSError:\n"
+        "            time.sleep(0.3)\n")
+    p = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_faults.py"),
+         str(bad)], capture_output=True, text=True)
+    assert p.returncode == 1
+    assert "FAULT001" in p.stdout
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    p = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_faults.py"),
+         str(good)], capture_output=True, text=True)
+    assert p.returncode == 0

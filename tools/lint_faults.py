@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Retry-pacing lint — static companion to ceph_tpu/common/backoff.py.
+
+One check, enforced by tests/test_lint.py:
+
+FAULT001  a literal ``time.sleep(...)`` / ``sleep(...)`` call inside
+          a retry loop — a ``for``/``while`` whose body also contains
+          a ``try``/``except`` — anywhere outside the backoff helper.
+          Fixed-interval retry pacing is how retry storms happen
+          (every waiter wakes in lockstep and re-hits the recovering
+          service together) and it ignores any op deadline; pace
+          retries with ``common/backoff.py``'s ``Backoff`` — jittered,
+          decorrelated, budgeted — instead.
+
+Poll loops without an except clause (``while not done: sleep``) are
+fine: they wait on local state, not on a failing peer, so there is
+nothing to storm.
+
+Suppression: append ``# fault-ok: <reason>`` to the sleep line (or
+the loop's introducing line).  The reason is mandatory — it is the
+allowlist entry.
+
+Usage:
+    python tools/lint_faults.py [paths...]   # default: ceph_tpu/
+Exit status 1 when violations are found.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+from dataclasses import dataclass
+from typing import Iterable, List, Optional
+
+SUPPRESS_MARK = "fault-ok:"
+
+# the backoff helper itself sleeps by design
+ALLOW_RAW_FILES = ("common/backoff.py",)
+
+
+@dataclass
+class Violation:
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _suppressed(src_lines: List[str], *linenos: int) -> bool:
+    for ln in linenos:
+        if 1 <= ln <= len(src_lines) and \
+                SUPPRESS_MARK in src_lines[ln - 1]:
+            return True
+    return False
+
+
+def _is_sleep_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "sleep":
+        # time.sleep / <anything>.sleep — but a Backoff handle's
+        # .sleep() IS the sanctioned pacing call
+        try:
+            owner = ast.unparse(f.value)
+        except Exception:
+            return True
+        tail = owner.rsplit(".", 1)[-1].lower()
+        return not ("backoff" in tail or tail in ("bo", "b_o"))
+    return isinstance(f, ast.Name) and f.id == "sleep"
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, path: str, rel: str, src: str):
+        self.path = path
+        self.rel = rel
+        self.lines = src.splitlines()
+        self.out: List[Violation] = []
+        self._seen: set = set()  # id() of already-reported sleeps
+        # (nested loops would otherwise report the same call twice)
+
+    def _emit(self, node: ast.AST, message: str,
+              *extra_lines: int) -> None:
+        if _suppressed(self.lines, node.lineno, *extra_lines):
+            return
+        self.out.append(Violation(self.rel, node.lineno, "FAULT001",
+                                  message))
+
+    @staticmethod
+    def _walk_frame(node):
+        """Descendants of ``node`` within the same frame: nested defs
+        are fresh frames — a sleep in an inner callback is not paced
+        by THIS loop."""
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef,
+                                ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            yield sub
+            stack.extend(ast.iter_child_nodes(sub))
+
+    def _check_loop(self, loop) -> None:
+        # a retry loop: the loop body catches failures and goes
+        # around again
+        has_try = False
+        sleeps: List[ast.Call] = []
+        for sub in self._walk_frame(loop):
+            if isinstance(sub, ast.Try) and sub.handlers:
+                has_try = True
+            if isinstance(sub, ast.Call) and _is_sleep_call(sub) \
+                    and id(sub) not in self._seen:
+                sleeps.append(sub)
+        if not has_try:
+            return
+        for call in sleeps:
+            self._seen.add(id(call))
+            self._emit(
+                call,
+                "fixed sleep inside a retry loop (try/except at "
+                f"loop line {loop.lineno}): pace retries with "
+                "common/backoff.py Backoff (jittered + deadline-"
+                "budgeted), not a literal interval",
+                loop.lineno)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_loop(node)
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_loop(node)
+        self.generic_visit(node)
+
+
+def lint_file(path: pathlib.Path,
+              root: Optional[pathlib.Path] = None) -> List[Violation]:
+    rel = str(path if root is None else path.relative_to(root))
+    if any(rel.endswith(f) for f in ALLOW_RAW_FILES):
+        return []
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [Violation(rel, e.lineno or 0, "FAULT000",
+                          f"unparseable: {e.msg}")]
+    linter = _FileLinter(str(path), rel, src)
+    linter.visit(tree)
+    return sorted(linter.out, key=lambda v: v.line)
+
+
+def lint_paths(paths: Iterable[pathlib.Path]) -> List[Violation]:
+    out: List[Violation] = []
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_dir():
+            root = p.parent
+            for f in sorted(p.rglob("*.py")):
+                out.extend(lint_file(f, root=root))
+        else:
+            out.extend(lint_file(p))
+    return out
+
+
+def main(argv: List[str]) -> int:
+    targets = [pathlib.Path(a) for a in argv] or \
+        [pathlib.Path(__file__).resolve().parents[1] / "ceph_tpu"]
+    violations = lint_paths(targets)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} fault-lint violation(s)")
+        return 1
+    print("fault lint clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -54,6 +54,8 @@ METRICS = [
     ("mc_dry_crush_eff", True),
     ("mc_dry_ec_eff", True),
     ("init_probe_s", False),
+    ("chaos_ops", True),
+    ("chaos_converge_s", False),
 ]
 
 _TAIL_PATTERNS = {
@@ -165,6 +167,30 @@ def load_multichip(path: str) -> Optional[Dict]:
                                           dryrun=True)}
 
 
+def load_chaos(path: str) -> Optional[Dict]:
+    """One CHAOS_rNN.json thrasher-soak record (tools/thrasher.py):
+    acked-op volume and HEALTH_OK convergence time become trajectory
+    metrics; lost acked writes or a failed soak (``ok`` false) are
+    regressions outright — there is no acceptable drift on
+    durability."""
+    try:
+        raw = json.load(open(path))
+    except (OSError, ValueError) as e:
+        print(f"# {path}: unreadable ({e})", file=sys.stderr)
+        return None
+    metrics: Dict[str, float] = {}
+    if isinstance(raw.get("ops"), (int, float)):
+        metrics["chaos_ops"] = float(raw["ops"])
+    if isinstance(raw.get("health_converge_s"), (int, float)):
+        metrics["chaos_converge_s"] = float(raw["health_converge_s"])
+    fail: List[str] = []
+    if raw.get("lost"):
+        fail.append(f"chaos_lost_writes={raw['lost']}")
+    if raw.get("ok") is False:
+        fail.append("chaos_soak_failed")
+    return {"metrics": metrics, "fail": fail}
+
+
 def load_all(directory: str) -> List[Dict]:
     rows = []
     for path in sorted(glob.glob(os.path.join(directory,
@@ -194,6 +220,27 @@ def load_all(directory: str) -> List[Dict]:
             rows.append(row)
         for k, v in mc["metrics"].items():
             row["metrics"].setdefault(k, v)
+    # CHAOS_rNN thrasher records merge the same way: chaos metrics
+    # land on the same-numbered bench row (or a standalone row), and
+    # their hard failures ride slo_fail into the regression check
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "CHAOS_r*.json"))):
+        m = re.search(r"CHAOS_r(\d+)\.json$", path)
+        ch = load_chaos(path)
+        if ch is None or m is None or \
+                not (ch["metrics"] or ch["fail"]):
+            continue
+        n = int(m.group(1))
+        row = by_n.get(n)
+        if row is None:
+            row = {"run": f"r{n:02d}", "n": n,
+                   "path": os.path.basename(path), "rc": None,
+                   "platform": None, "metrics": {}, "slo_fail": []}
+            by_n[n] = row
+            rows.append(row)
+        for k, v in ch["metrics"].items():
+            row["metrics"].setdefault(k, v)
+        row["slo_fail"].extend(ch["fail"])
     rows.sort(key=lambda r: r["n"])
     return rows
 

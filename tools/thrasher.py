@@ -1,0 +1,314 @@
+#!/usr/bin/env python
+"""thrasher — seeded chaos soak over the MiniCluster.
+
+The qa/tasks/thrashosds.py role as a standalone tool: concurrent
+writers against a replicated and an EC pool while OSDs (and quorum
+monitors) are killed/revived under them AND the fault-injection plane
+(ceph_tpu/analysis/faults.py) is armed — dropped/corrupted frames on
+the wire, an injected shard-read EIO, a slowed OSD.  The invariants
+checked are the storage system's whole promise:
+
+  * every ACKED write is readable afterwards, at its acked value;
+  * the cluster converges back to HEALTH_OK once the chaos stops;
+  * the analysis planes stay clean (no lockdep violations, no leaked
+    tracing spans);
+  * every armed failpoint actually fired (a soak that injected
+    nothing proved nothing).
+
+Determinism: ONE seed drives both the thrash schedule (victim choice,
+action pacing) and the fault plane's probability draws
+(``faults.seed``), so a failing run reproduces from its recorded
+seed::
+
+    python tools/thrasher.py --seed 8 --duration 20
+    python tools/thrasher.py --seed 8 --duration 20   # same schedule
+
+Each run emits a ``CHAOS_rNN.json`` record beside the BENCH_r*.json
+series; tools/perf_history.py ingests them into the same trajectory
+table (``chaos_ops`` / ``chaos_converge_s`` columns) and flags a run
+with lost writes or failed convergence as a regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import random
+import re
+import shutil
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+from ceph_tpu.analysis import faults, lockdep  # noqa: E402
+from ceph_tpu.common import tracing  # noqa: E402
+from ceph_tpu.common.backoff import Backoff  # noqa: E402
+from ceph_tpu.common.config import Config  # noqa: E402
+from ceph_tpu.services.client import ObjectNotFound  # noqa: E402
+from ceph_tpu.services.cluster import MiniCluster  # noqa: E402
+
+# the acceptance fault mix: wire chaos probabilistic (recoverable by
+# design — reconnect+replay), the destructive arms COUNTED so a soak
+# can't cascade shard removals past the EC profile's m (that would
+# manufacture data loss no real cluster promised to survive)
+DEFAULT_SPEC = ("msgr.drop_frame=p:0.02;"
+                "msgr.corrupt_frame=p:0.02;"
+                "msgr.dup_frame=p:0.02;"
+                "osd.slow_op=p:0.05,delay:0.03;"
+                "osd.shard_read_eio=count:1")
+
+
+def _conf() -> Config:
+    c = Config()
+    c.set("osd_heartbeat_interval", 0.2)
+    c.set("osd_heartbeat_grace", 1.2)
+    c.set("mon_osd_down_out_interval", 1.5)
+    c.set("mon_lease", 0.3)
+    c.set("mon_election_timeout", 0.5)
+    return c
+
+
+class _Writer(threading.Thread):
+    """Loops put/delete (and EC partial overwrites) over its own key
+    space, recording the last ACKED value per key; keys touched by an
+    UNACKED attempt are 'dirty' — the op may still have landed
+    durably (a legal outcome), so only readability is asserted."""
+
+    def __init__(self, cluster: MiniCluster, wid: int, pool_id: int,
+                 ec: bool):
+        super().__init__(daemon=True, name=f"chaos-w{wid}")
+        self.cluster = cluster
+        self.wid = wid
+        self.pool = pool_id
+        self.ec = ec
+        self.cli = cluster.client(f"chaos-w{wid}-{pool_id}")
+        self.acked: Dict[str, Optional[bytes]] = {}
+        self.dirty: set = set()
+        self.ops = 0
+        self.stop = threading.Event()
+
+    def run(self) -> None:
+        i = 0
+        while not self.stop.is_set():
+            key = f"w{self.wid}-k{i % 7}"
+            val = f"{self.wid}:{i}:".encode() * 40
+            op = None
+            try:
+                if i % 11 == 10:
+                    op = "delete"
+                    self.cli.delete(self.pool, key)
+                    self.acked[key] = None
+                    self.dirty.discard(key)
+                else:
+                    op = "put"
+                    self.cli.put(self.pool, key, val)
+                    self.acked[key] = val
+                    self.dirty.discard(key)
+                self.ops += 1
+            except Exception:
+                if op is not None:
+                    self.dirty.add(key)
+            i += 1
+        self.cli.shutdown()
+
+
+def _verify(cluster: MiniCluster,
+            writers: List[_Writer]) -> List[tuple]:
+    """Read back every acked key; returns the violations."""
+    checker = cluster.client("chaos-check")
+    bad: List[tuple] = []
+    try:
+        for w in writers:
+            for key, want in w.acked.items():
+                fuzzy = key in w.dirty
+                bo = Backoff(base=0.2, cap=1.0, deadline=20.0)
+                while True:
+                    try:
+                        try:
+                            got = checker.get(w.pool, key,
+                                              notfound_retries=0)
+                        except ObjectNotFound:
+                            got = None
+                        if fuzzy:
+                            break  # readable (or legally absent)
+                        if got == want:
+                            break
+                        if not bo.sleep():
+                            bad.append((w.pool, key, "mismatch"))
+                            break
+                    except Exception as e:  # fault-ok: Backoff-paced
+                        if not bo.sleep():
+                            bad.append((w.pool, key, repr(e)))
+                            break
+    finally:
+        checker.shutdown()
+    return bad
+
+
+def soak(seed: int = 0, duration: float = 20.0, n_osds: int = 5,
+         n_mons: int = 1, spec: str = DEFAULT_SPEC,
+         settle_timeout: float = 60.0) -> Dict:
+    """One seeded chaos soak; returns the CHAOS record dict."""
+    rng = random.Random(seed)
+    faults.reset()
+    faults.seed(seed)
+    base_lockdep = len(lockdep.violations())
+    base_spans = {id(s) for _svc, s in tracing.active_spans()}
+
+    # persistent stores: kill/revive is a daemon crash+restart over
+    # the OSD's surviving disk (the thrashosds contract), NOT a disk
+    # wipe.  Without this, every revive reformats the store, and two
+    # kills inside one recovery window erase 2 of 3 shards — loss the
+    # k=2/m=1 profile never promised to survive.
+    data_root = tempfile.mkdtemp(prefix=f"chaos-s{seed}-")
+    c = MiniCluster(n_osds=n_osds, hosts=n_osds, config=_conf(),
+                    n_mons=n_mons, data_dir=data_root).start()
+    result: Dict = {"kind": "chaos", "seed": seed,
+                    "duration": duration, "n_osds": n_osds,
+                    "n_mons": n_mons, "spec": spec}
+    try:
+        c.create_replicated_pool(1, pg_num=8, size=3)
+        c.create_ec_pool(2, "chaos21", {"plugin": "jerasure",
+                                        "technique": "reed_sol_van",
+                                        "k": "2", "m": "1", "w": "8"},
+                         pg_num=8)
+        writers = [_Writer(c, 0, 1, ec=False),
+                   _Writer(c, 1, 1, ec=False),
+                   _Writer(c, 2, 2, ec=True)]
+        for w in writers:
+            w.start()
+        c.set_faults(spec)
+
+        end = time.monotonic() + duration
+        while time.monotonic() < end:
+            victim = rng.randrange(n_osds)
+            c.kill_osd(victim)
+            if n_mons > 1 and rng.random() < 0.3:
+                rank = rng.randrange(1, n_mons)
+                if rank in c.mons and len(c.mons) == n_mons:
+                    c.kill_mon(rank)
+                    time.sleep(0.5 + rng.random())
+                    c.revive_mon(rank)
+            time.sleep(0.8 + rng.random())
+            c.revive_osd(victim)
+            time.sleep(0.4 + rng.random() * 0.4)
+
+        # chaos off; give in-flight faulted ops a beat to drain so
+        # the writers' LAST acked values are post-fault reality
+        c.set_faults("")
+        time.sleep(1.0)
+        for w in writers:
+            w.stop.set()
+        for w in writers:
+            w.join(timeout=30)
+        result["ops"] = sum(w.ops for w in writers)
+
+        # settle: all osds up, then time the HEALTH_OK convergence
+        for o in range(n_osds):
+            if o not in c.osds:
+                c.revive_osd(o)
+        t0 = time.monotonic()
+        try:
+            c.wait_for_health_ok(timeout=settle_timeout)
+            result["health_converge_s"] = round(
+                time.monotonic() - t0, 3)
+            converged = True
+        except TimeoutError as e:
+            result["health_converge_s"] = None
+            result["health_error"] = str(e)
+            converged = False
+        time.sleep(2.0)  # a peering pass after the last epoch
+
+        bad = _verify(c, writers)
+        result["checked"] = sum(len(w.acked) for w in writers)
+        result["lost"] = len(bad)
+        result["bad"] = [list(b) for b in bad[:5]]
+        result["fired"] = faults.snapshot()
+        armed = [p.strip().split("=")[0]
+                 for p in spec.split(";") if p.strip()]
+        result["unfired_armed"] = sorted(
+            n for n in armed if not result["fired"].get(n))
+    finally:
+        c.shutdown()
+        faults.reset()
+        shutil.rmtree(data_root, ignore_errors=True)
+
+    result["lockdep_violations"] = \
+        len(lockdep.violations()) - base_lockdep
+    # daemon threads die with their sockets; give them a beat before
+    # judging the span plane
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        leaks = [s for _svc, s in tracing.active_spans()
+                 if id(s) not in base_spans]
+        if not leaks:
+            break
+        time.sleep(0.1)
+    result["span_leaks"] = len(
+        [s for _svc, s in tracing.active_spans()
+         if id(s) not in base_spans])
+    result["ok"] = bool(
+        result.get("lost") == 0 and converged
+        and result["lockdep_violations"] == 0
+        and result["span_leaks"] == 0
+        and not result["unfired_armed"])
+    return result
+
+
+def next_run_number(directory: str) -> int:
+    """One past the newest committed record of ANY series (BENCH /
+    MULTICHIP / CHAOS) so the chaos record pairs with its PR's run."""
+    n = 0
+    for path in glob.glob(os.path.join(directory, "*_r*.json")):
+        m = re.search(r"_r(\d+)\.json$", path)
+        if m:
+            n = max(n, int(m.group(1)))
+    return n or 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="thrasher")
+    ap.add_argument("--seed", type=int, default=8,
+                    help="drives the thrash schedule AND the fault "
+                         "plane's probability draws (default 8)")
+    ap.add_argument("--duration", type=float, default=20.0,
+                    help="seconds of active chaos (default 20)")
+    ap.add_argument("--osds", type=int, default=5)
+    ap.add_argument("--mons", type=int, default=1)
+    ap.add_argument("--spec", default=DEFAULT_SPEC,
+                    help="fault_inject_spec to arm during the soak")
+    ap.add_argument("--out", default=None,
+                    help="output record path (default "
+                         "CHAOS_rNN.json, NN from the newest "
+                         "committed record)")
+    args = ap.parse_args(argv)
+
+    out = args.out
+    if out is None:
+        n = next_run_number(_ROOT)
+        out = os.path.join(_ROOT, f"CHAOS_r{n:02d}.json")
+    m = re.search(r"_r(\d+)\.json$", out)
+    rec = soak(seed=args.seed, duration=args.duration,
+               n_osds=args.osds, n_mons=args.mons, spec=args.spec)
+    rec["n"] = int(m.group(1)) if m else 0
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+        f.write("\n")
+    print(f"# chaos seed={rec['seed']} ops={rec.get('ops')} "
+          f"lost={rec.get('lost')} "
+          f"converge={rec.get('health_converge_s')}s "
+          f"fired={rec.get('fired')} -> "
+          f"{'OK' if rec['ok'] else 'FAIL'} ({out})")
+    return 0 if rec["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
