@@ -90,6 +90,12 @@ SLO_FLOORS = {
         "CEPH_TPU_SLO_MULTICHIP_CRUSH_FLOOR", 500)),
     "multichip_encode_gbps": float(os.environ.get(
         "CEPH_TPU_SLO_MULTICHIP_EC_FLOOR", 0.01)),
+    # the balancer lane's floor is sweep throughput (batched remapped
+    # PGs per second across the loop's evaluation sweeps) on CPU CI,
+    # where early sweeps pay compile; convergence itself is gated by
+    # perf_history (a non-converged BALANCE record is a red check)
+    "balancer_sweep_mappings_per_sec": float(os.environ.get(
+        "CEPH_TPU_SLO_BALANCE_SWEEP_FLOOR", 50)),
 }
 
 
@@ -525,6 +531,60 @@ def worker_cluster():
                    out["write"].get("iops") or 0.0,
                    p50_ms=out["write"].get("lat_p50_ms"),
                    p99_ms=out["write"].get("lat_p99_ms")))
+
+
+def worker_balancer():
+    """The placement-quality lane (ROADMAP item 5): the mgr balancer
+    module's closed loop driven offline against a synthetic N-OSD map
+    with seeded-uneven weights (ceph_tpu/mgr/synthetic.py), every
+    evaluation ONE batched PoolMapper launch per pool.  Records
+    rounds-to-converge, initial/final deviation stddev, and sweep
+    mappings/s; CEPH_TPU_BALANCE_OUT writes the BALANCE_r*.json body
+    tools/perf_history.py ingests.
+
+    Env knobs (the tier-1 smoke test shrinks the workload):
+    CEPH_TPU_BALANCE_OSDS / _PGS / _SEED / _MAX_DEVIATION / _ITERS /
+    _ROUNDS / _CLASSES (comma list, e.g. 'ssd,hdd') / _OUT."""
+    t_boot = time.perf_counter()
+    import jax
+
+    _enable_compile_cache()
+    plat = jax.devices()[0].platform
+    _emit(stage="init", platform=plat,
+          init_s=round(time.perf_counter() - t_boot, 1))
+
+    from ceph_tpu.mgr import make_synthetic_map, run_offline
+
+    n_osds = int(os.environ.get("CEPH_TPU_BALANCE_OSDS", 1000))
+    pg_num = int(os.environ.get("CEPH_TPU_BALANCE_PGS", 4096))
+    seed = int(os.environ.get("CEPH_TPU_BALANCE_SEED", 10))
+    max_dev = int(os.environ.get("CEPH_TPU_BALANCE_MAX_DEVIATION", 1))
+    iters = int(os.environ.get("CEPH_TPU_BALANCE_ITERS", 400))
+    rounds = int(os.environ.get("CEPH_TPU_BALANCE_ROUNDS", 40))
+    classes = [c for c in os.environ.get(
+        "CEPH_TPU_BALANCE_CLASSES", "").split(",") if c]
+
+    m, w, _rules = make_synthetic_map(
+        n_osds=n_osds, pg_num=pg_num, seed=seed, uneven=True,
+        device_classes=classes or None)
+    c0 = _lib_counters()
+    rec = run_offline(m, w, max_deviation=max_dev,
+                      max_iterations=iters, max_rounds=rounds,
+                      seed=seed)
+    reduction = (rec["initial_stddev"] / rec["final_stddev"]
+                 if rec["final_stddev"] else float("inf"))
+    rec.update(platform=plat, pg_num=pg_num,
+               stddev_reduction=round(reduction, 2))
+    _emit(stage="balancer",
+          counters=_counter_deltas(c0, _lib_counters()),
+          slo=_slo("balancer_sweep_mappings_per_sec",
+                   rec["sweep_mappings_per_sec"]),
+          **rec)
+    out = os.environ.get("CEPH_TPU_BALANCE_OUT")
+    if out:
+        with open(out, "w") as f:
+            json.dump(rec, f, indent=1)
+            f.write("\n")
 
 
 def worker_multichip():
@@ -964,6 +1024,7 @@ if __name__ == "__main__":
          "ec_profiles": lambda: _try_stage(
              "ec/profiles", _stage_ec_profiles),
          "cluster": worker_cluster,
-         "multichip": worker_multichip}[sys.argv[2]]()
+         "multichip": worker_multichip,
+         "balancer": worker_balancer}[sys.argv[2]]()
     else:
         main()

@@ -81,6 +81,10 @@ FAILPOINTS: Dict[str, str] = {
     "mon.drop_pg_stats": "monitor drops an incoming pg_stats beacon",
     "mon.isolate_rank": "monitor drops all mon-to-mon traffic "
                         "(rank isolation / partition)",
+    # manager faults
+    "mgr.balancer.stale_map": "balancer sweep evaluated a stale "
+                              "OSDMap; the round's proposals are "
+                              "discarded",
 }
 
 _VALID_ARMS = ("p", "count", "oneshot", "off")

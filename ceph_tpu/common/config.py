@@ -141,6 +141,23 @@ OPTIONS: Dict[str, Option] = _opts(
     Option("mon_pool_stats_retention", int, 240,
            "per-pool stat samples retained by the monitor's PGMap "
            "ring (the `pool-stats` rate series)"),
+    Option("debug_mgr", int, 0, "manager subsystem log level"),
+    Option("mgr_tick_interval", float, 0.5,
+           "mgr module scheduler pass interval; each module re-arms "
+           "with a jittered draw around its own interval"),
+    Option("mgr_modules", str, "balancer",
+           "comma-separated mgr modules enabled at startup (the "
+           "mgr_initial_modules role)"),
+    Option("balancer_interval", float, 2.0,
+           "seconds between balancer rounds when active (the "
+           "balancer sleep_interval role)"),
+    Option("balancer_max_deviation", int, 5,
+           "PG-count deviation from the weight-proportional target "
+           "below which an OSD is considered balanced "
+           "(upmap_max_deviation)"),
+    Option("balancer_max_iterations", int, 10,
+           "calc_pg_upmaps optimizer iterations per round "
+           "(upmap_max_optimizations)"),
     Option("fault_inject_spec", str, "",
            "armed failpoints (analysis/faults.py spec syntax, e.g. "
            "'msgr.corrupt_frame=p:0.02;osd.slow_op=p:0.1,delay:0.05')"

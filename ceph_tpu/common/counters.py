@@ -123,6 +123,21 @@ REGISTRY: Dict[str, Dict[str, str]] = {
         "osd.shard_read_eio": U64,
         "mon.drop_pg_stats": U64,
         "mon.isolate_rank": U64,
+        "mgr.balancer.stale_map": U64,
+    },
+    # the manager daemon + module plane (ceph_tpu/mgr): scheduler
+    # accounting plus the balancer loop's round/proposal counters and
+    # its live balance gauges (deviation stddev, distribution score)
+    "mgr": {
+        "ticks": U64,
+        "module_runs": U64,
+        "module_errors": U64,
+        "balancer_rounds": U64,
+        "balancer_upmaps_proposed": U64,
+        "balancer_sweep_launches": U64,
+        "balancer_paused": U64,
+        "balancer_stddev": GAUGE,
+        "balancer_score": GAUGE,
     },
     # the device plane (common/device_metrics.py): host<->device
     # transfer volume, kernel launch accounting, and live-buffer /

@@ -1,0 +1,10 @@
+"""Manager daemon + module plane (the src/mgr + src/pybind/mgr role)."""
+
+from .balancer_module import (BalancerModule, diff_upmap_items,
+                              evaluate, run_offline)
+from .daemon import MgrDaemon, MgrModule, module_registry
+from .synthetic import make_synthetic_map
+
+__all__ = ["MgrDaemon", "MgrModule", "module_registry",
+           "BalancerModule", "evaluate", "run_offline",
+           "diff_upmap_items", "make_synthetic_map"]

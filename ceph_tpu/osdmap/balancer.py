@@ -95,12 +95,20 @@ def try_pg_upmap(m: OSDMap, wrapper: CrushWrapper, pool_id: int,
 
 def build_pgs_by_osd(m: OSDMap,
                      only_pools: Optional[Set[int]] = None,
-                     use_batched: bool = False
-                     ) -> Dict[int, Set[PgId]]:
+                     use_batched: bool = False,
+                     mappers: Optional[Dict[int, object]] = None,
+                     mesh=None) -> Dict[int, Set[PgId]]:
     """Map every PG of every (selected) pool and tally per OSD — the
     full-cluster remap (OSDMap.cc:4633-4646).  ``use_batched`` routes
     through the fused batched pipeline (one TPU launch per pool);
-    otherwise the scalar spec."""
+    otherwise the scalar spec.
+
+    ``mappers`` is a caller-owned ``{pool_id: PoolMapper}`` cache: the
+    closed balancer loop re-sweeps the same pools every round, so a
+    cached mapper only relowers its exception tables
+    (``refresh_tables``) instead of rebuilding the compiled program.
+    ``mesh`` shards each pool's PG axis across the device mesh (the
+    PlacementPlane distribution shape from the multichip plane)."""
     pgs_by_osd: Dict[int, Set[PgId]] = {}
     for pool_id, pool in m.pools.items():
         if only_pools and pool_id not in only_pools:
@@ -110,7 +118,16 @@ def build_pgs_by_osd(m: OSDMap,
 
             from .pipeline_jax import PoolMapper
 
-            out = PoolMapper(m, pool_id).map_all()
+            if mappers is not None:
+                pm = mappers.get(pool_id)
+                if pm is None or pm.m is not m:
+                    pm = PoolMapper(m, pool_id, mesh)
+                    mappers[pool_id] = pm
+                else:
+                    pm.refresh_tables()
+            else:
+                pm = PoolMapper(m, pool_id, mesh)
+            out = pm.map_all()
             up = np.asarray(out["up"])
             ulen = np.asarray(out["up_len"])
             for ps in range(pool.pg_num):
@@ -126,6 +143,32 @@ def build_pgs_by_osd(m: OSDMap,
                         pgs_by_osd.setdefault(o, set()).add(
                             (pool_id, ps))
     return pgs_by_osd
+
+
+def target_osd_weights(m: OSDMap, wrapper: CrushWrapper,
+                       only_pools: Optional[Set[int]] = None
+                       ) -> Tuple[Dict[int, float], float, int]:
+    """The per-OSD weight-proportional targets every deviation sweep
+    measures against (OSDMap.cc:4646-4700): each selected pool's rule
+    tree contributes its normalized per-OSD share scaled by the
+    reweight column.  Returns (osd_weight, weight_total, total_pgs)."""
+    total_pgs = 0
+    osd_weight: Dict[int, float] = {}
+    osd_weight_total = 0.0
+    for pool_id, pool in m.pools.items():
+        if only_pools and pool_id not in only_pools:
+            continue
+        total_pgs += pool.size * pool.pg_num
+        pmap = get_rule_weight_osd_map(wrapper, pool.crush_rule)
+        for osd, share in pmap.items():
+            if osd >= len(m.osd_weight):
+                continue
+            adjusted = (m.osd_weight[osd] / 0x10000) * share
+            if adjusted == 0:
+                continue
+            osd_weight[osd] = osd_weight.get(osd, 0.0) + adjusted
+            osd_weight_total += adjusted
+    return osd_weight, osd_weight_total, total_pgs
 
 
 def _deviations(pgs_by_osd: Dict[int, Set[PgId]],
@@ -156,7 +199,9 @@ def calc_pg_upmaps(m: OSDMap,
                    use_batched: bool = False,
                    aggressive: bool = True,
                    local_fallback_retries: int = 100,
-                   seed: int = 0) -> int:
+                   seed: int = 0,
+                   mappers: Optional[Dict[int, object]] = None,
+                   mesh=None) -> int:
     """OSDMap.cc:4618.  Mutates ``m.pg_upmap_items`` in place; returns
     the number of table changes (additions + removals)."""
     if max_deviation < 1:
@@ -166,24 +211,11 @@ def calc_pg_upmaps(m: OSDMap,
     rng = random.Random(seed)
 
     # -- the one full-cluster remap (the TPU launch) -------------------
-    pgs_by_osd = build_pgs_by_osd(m, only_pools, use_batched)
+    pgs_by_osd = build_pgs_by_osd(m, only_pools, use_batched,
+                                  mappers=mappers, mesh=mesh)
 
-    total_pgs = 0
-    osd_weight: Dict[int, float] = {}
-    osd_weight_total = 0.0
-    for pool_id, pool in m.pools.items():
-        if only_pools and pool_id not in only_pools:
-            continue
-        total_pgs += pool.size * pool.pg_num
-        pmap = get_rule_weight_osd_map(wrapper, pool.crush_rule)
-        for osd, share in pmap.items():
-            if osd >= len(m.osd_weight):
-                continue
-            adjusted = (m.osd_weight[osd] / 0x10000) * share
-            if adjusted == 0:
-                continue
-            osd_weight[osd] = osd_weight.get(osd, 0.0) + adjusted
-            osd_weight_total += adjusted
+    osd_weight, osd_weight_total, total_pgs = target_osd_weights(
+        m, wrapper, only_pools)
     for osd in osd_weight:
         pgs_by_osd.setdefault(osd, set())
     # drop tallies for osds outside the weight map (down/out devices)

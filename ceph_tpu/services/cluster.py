@@ -68,6 +68,7 @@ class MiniCluster:
                 mon.set_peers(rank, self.mon_addrs)
         self.osds: Dict[int, OSDService] = {}
         self.clients: List[Client] = []
+        self.mgr = None
 
     @property
     def mon(self) -> Monitor:
@@ -100,11 +101,26 @@ class MiniCluster:
     def shutdown(self) -> None:
         for c in self.clients:
             c.shutdown()
+        if self.mgr is not None:
+            self.mgr.shutdown()
+            self.mgr = None
         for svc in list(self.osds.values()):
             svc.shutdown()
         for mon in self.mons.values():
             mon.shutdown()
         shutil.rmtree(self.asok_dir, ignore_errors=True)
+
+    def start_mgr(self, name: str = "x"):
+        """Start the manager daemon (one per cluster, the ceph-mgr
+        role); its admin socket binds beside the others, so
+        ``ceph_cli balancer ...`` finds it via --asok-dir."""
+        from ..mgr.daemon import MgrDaemon
+
+        ctx = Context(f"mgr.{name}", config=self.conf,
+                      admin_dir=self.asok_dir)
+        self.mgr = MgrDaemon(ctx, name, self.mon_addrs,
+                             keyring=self.keyring).start()
+        return self.mgr
 
     def client(self, name: str = "admin") -> Client:
         ctx = Context(f"client.{name}", config=self.conf,

@@ -130,6 +130,11 @@ class Monitor:
                            False),
                           ("pg_temp_set",
                            self._fwd(self._h_pg_temp_set), False),
+                          ("pg_upmap_items_set",
+                           self._fwd(self._h_pg_upmap_items_set),
+                           False),
+                          ("mgr_health_report",
+                           self._h_mgr_health_report, False),
                           ("ec_profile_set",
                            self._fwd(self._h_ec_profile_set), False),
                           ("pg_stats", self._h_pg_stats, False),
@@ -156,6 +161,9 @@ class Monitor:
         self._progress_done: Deque[Dict] = collections.deque(
             maxlen=32)
         self._progress_seq = 0
+        # latest mgr-module health report (mgr broadcasts to every
+        # member); folded into _h_health while within the grace
+        self._mgr_health: Optional[Dict] = None
 
     # -- quorum ---------------------------------------------------------
     def set_peers(self, rank: int, addrs: List[Addr]) -> None:
@@ -583,6 +591,53 @@ class Monitor:
                 del self.map.pg_temp[pgid]
         return {"epoch": self._commit(f"pg_temp {pgid}")}
 
+    def _h_pg_upmap_items_set(self, msg: Dict) -> Dict:
+        """Balancer-proposed remap pairs (the OSDMonitor
+        osd pg-upmap-items flow, OSDMonitor.cc:13736): install the
+        PG's ``pg_upmap_items`` exception list and commit — the change
+        rides the incremental's new_pg_upmap_items delta to every
+        subscriber.  An empty list clears the entry."""
+        pgid = (int(msg["pool"]), int(msg["ps"]))
+        items = [(int(f), int(t)) for f, t in msg.get("items", [])]
+        with self._lock:
+            pool = self.map.pools.get(pgid[0])
+            if pool is None:
+                return {"error": f"no pool {pgid[0]}"}
+            if pgid[1] >= pool.pg_num:
+                return {"error": f"ps {pgid[1]} >= pg_num "
+                                 f"{pool.pg_num}"}
+            if len(items) > pool.size:
+                # the reference monitor rejects wider-than-pool entry
+                # lists (and the batched pipeline's fixed result
+                # width could not hold them)
+                return {"error": f"{len(items)} pairs > pool size "
+                                 f"{pool.size}"}
+            cur = self.map.pg_upmap_items.get(pgid)
+            if items:
+                if cur == items:
+                    return {"epoch": self.map.epoch}
+                self.map.pg_upmap_items[pgid] = items
+            else:
+                if cur is None:
+                    return {"epoch": self.map.epoch}
+                del self.map.pg_upmap_items[pgid]
+        return {"epoch": self._commit(f"pg_upmap_items {pgid}")}
+
+    def _h_mgr_health_report(self, msg: Dict) -> None:
+        """Mgr-module health checks (the MMgrBeacon health payload
+        role): kept beside the PGMap observability state — NOT part
+        of the replicated epoch log — and folded into ``_h_health``
+        while fresh.  The mgr broadcasts to every member, so any mon
+        serves the same fold."""
+        checks = {str(k): str(v)
+                  for k, v in (msg.get("checks") or {}).items()}
+        with self._lock:
+            self._mgr_health = {
+                "name": msg.get("name", "mgr"),
+                "checks": checks,
+                "ts": time.monotonic()}
+        return None
+
     def _h_pool_create(self, msg: Dict) -> Dict:
         pool_id = int(msg["pool_id"])
         with self._lock:
@@ -865,6 +920,10 @@ class Monitor:
             slow = [e for e in recovering
                     if time.time() - e.get("started_at", 0)
                     > slow_grace]
+            mgr_checks: Dict[str, str] = {}
+            if self._mgr_health is not None and \
+                    now - self._mgr_health["ts"] < grace:
+                mgr_checks = dict(self._mgr_health["checks"])
         checks = []
         if down:
             checks.append(f"OSD_DOWN: {len(down)} osds down: {down}")
@@ -898,6 +957,8 @@ class Monitor:
             checks.append(
                 f"{pgs['pgs_total'] - pgs['pgs_reported']} pgs never "
                 f"reported by a primary")
+        for code in sorted(mgr_checks):
+            checks.append(f"{code}: {mgr_checks[code]}")
         return {"status": "HEALTH_OK" if not checks else "HEALTH_WARN",
                 "checks": checks,
                 "check_codes": sorted({c.split(":", 1)[0]

@@ -18,6 +18,9 @@ CLI:
         pool delete ID | pool-stats [ID] | progress
     python -m ceph_tpu.tools.ceph_cli --asok-dir DIR \
         daemonperf | top | history | telemetry snapshot|prom|traces
+    python -m ceph_tpu.tools.ceph_cli --asok-dir DIR \
+        balancer status|on|off|eval|execute |
+        mgr module ls|enable|disable NAME
     python -m ceph_tpu.tools.ceph_cli \
         dencoder list | encode TYPE | decode TYPE [HEXFILE] |
         roundtrip [TYPE]
@@ -55,6 +58,55 @@ def _jsonable(obj):
     if isinstance(obj, (list, tuple)):
         return [_jsonable(v) for v in obj]
     return obj
+
+
+def _mgr_verb(args, extra) -> int:
+    """Route `balancer ...` / `mgr ...` through the manager daemon's
+    admin socket (`ceph balancer status|on|off|eval|execute`, `ceph
+    mgr module ls|enable|disable`)."""
+    import glob
+    import os
+
+    from ..common.admin_socket import AdminSocket
+
+    if not args.asok_dir:
+        print("balancer/mgr verbs need --asok-dir", file=sys.stderr)
+        return 2
+    socks = sorted(glob.glob(
+        os.path.join(args.asok_dir, "mgr.*.asok")))
+    if not socks:
+        print(f"no mgr admin socket under {args.asok_dir}",
+              file=sys.stderr)
+        return 2
+    argv = args.verb[1:] + extra
+    try:
+        # generous deadline: a cold `balancer eval` pays the batched
+        # sweep's first XLA compile inside the request
+        rep = AdminSocket.request(socks[0], args.verb[0], timeout=60.0,
+                                  argv=argv)
+    except OSError as e:
+        print(f"mgr admin socket: {e}", file=sys.stderr)
+        return 1
+    if isinstance(rep, dict) and rep.get("error"):
+        print(json.dumps(rep), file=sys.stderr)
+        return 1
+    if args.verb[0] == "balancer" and argv[:1] == ["eval"] and \
+            isinstance(rep, dict):
+        # the per-pool score breakdown, human-shaped
+        print(f"cluster: stddev {rep.get('stddev', 0.0):.3f} "
+              f"score {rep.get('score', 0.0):.6f} "
+              f"max_dev {rep.get('max_dev', 0.0):.2f} "
+              f"({rep.get('osd_count')} osds, "
+              f"{rep.get('sweep_launches')} sweeps)")
+        for pid, row in sorted((rep.get("pools") or {}).items()):
+            print(f"pool {pid}: pg_num {row.get('pg_num')} "
+                  f"size {row.get('size')} "
+                  f"stddev {row.get('stddev', 0.0):.3f} "
+                  f"score {row.get('score', 0.0):.6f} "
+                  f"max_dev {row.get('max_dev', 0.0):.2f}")
+        return 0
+    print(json.dumps(rep, indent=1, sort_keys=True))
+    return 0
 
 
 def _dencoder(verb, extra) -> int:
@@ -144,6 +196,12 @@ def main(argv=None) -> int:
             sub = args.verb[0]
         return telemetry.main(["--asok-dir", args.asok_dir, sub]
                               + args.verb[2:] + extra)
+
+    # the manager verbs route through the mgr's admin socket (the
+    # `ceph balancer ...` / `ceph mgr module ...` surfaces): the mgr
+    # owns the module plane, not the monitor
+    if args.verb[0] in ("balancer", "mgr"):
+        return _mgr_verb(args, extra)
 
     if extra:
         print(f"unrecognized arguments: {' '.join(extra)}",

@@ -191,6 +191,7 @@ DEFAULT_COLUMNS: List[Tuple[str, str, str]] = [
     ("ec.engine", "encode_ops", "ecenc/s"),
     ("client.*", "ops_aio_put", "aput/s"),
     ("mon*", "epochs", "epo/s"),
+    ("mgr*", "balancer_rounds", "bal/s"),
 ]
 
 

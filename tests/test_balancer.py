@@ -228,3 +228,72 @@ def test_try_remap_rule_randomized_differential_big10k():
     # the property test must actually exercise remaps, not vacuously
     # pass on "nothing changed"
     assert checked >= 1900 and remapped >= 1000, (checked, remapped)
+
+
+# -- PR 10 satellites: calc_pg_upmaps edge cases ------------------------
+
+def test_calc_pg_upmaps_device_class_rules_stay_in_class():
+    """A class-scoped pool's upmap targets never leave the device
+    class: the rule's weight map only contains class members, so
+    overfull/underfull sets — and thus every proposed move — are
+    class-local."""
+    from ceph_tpu.mgr import make_synthetic_map
+
+    m, w, rules = make_synthetic_map(
+        n_osds=16, osds_per_host=2, hosts_per_rack=4, pg_num=64,
+        seed=5, device_classes=["ssd", "hdd"])
+    ssd = {d for d in range(16) if d % 2 == 0}  # round-robin classes
+    changed = calc_pg_upmaps(m, max_deviation=1, max_iterations=20,
+                             wrapper=w, only_pools={2})
+    assert changed > 0, "uneven class pool produced no upmaps"
+    for pgid, items in m.pg_upmap_items.items():
+        assert pgid[0] == 2
+        for frm, to in items:
+            assert frm in ssd and to in ssd, \
+                f"pg {pgid}: move {frm}->{to} left class ssd"
+
+
+def test_try_remap_rule_rejects_failure_domain_collision():
+    """size == hosts: every host is a used failure domain, so the
+    only underfull candidate (the sibling of a RETAINED member)
+    collides and the mapping must come back unchanged."""
+    m, w, rid = make_cluster(hosts=3, osds_per_host=2, pg_num=16,
+                             size=3)
+    # orig: one device per host; swap target osd.3 shares host1 with
+    # the retained osd.2
+    orig = [0, 2, 4]
+    out = w.try_remap_rule(rid, 3, overfull={0}, underfull=[3],
+                           more_underfull=[], orig=orig)
+    assert out == orig
+    # a non-colliding candidate on the SAME construction is taken
+    out2 = w.try_remap_rule(rid, 3, overfull={0}, underfull=[1],
+                            more_underfull=[], orig=orig)
+    assert out2 == [1, 2, 4]
+
+
+def test_run_offline_balanced_map_is_noop():
+    from ceph_tpu.mgr import make_synthetic_map, run_offline
+
+    m, w, _rules = make_synthetic_map(
+        n_osds=16, osds_per_host=2, hosts_per_rack=4, pg_num=64,
+        seed=0, uneven=False)
+    # tolerance above this map's natural CRUSH variance (max_dev 6):
+    # within tolerance means balanced, and balanced means untouched
+    rec = run_offline(m, w, max_deviation=8, max_iterations=10,
+                      max_rounds=5, seed=0)
+    assert rec["converged"]
+    assert rec["upmaps"] == 0
+    assert not m.pg_upmap_items
+    assert rec["final_stddev"] == rec["initial_stddev"]
+
+
+def test_calc_pg_upmaps_seeded_reproducibility():
+    results = []
+    for _ in range(2):
+        m, w, rid = make_cluster(hosts=4, osds_per_host=4, pg_num=128)
+        w.adjust_item_weight(0, 0x20000)  # force imbalance
+        changed = calc_pg_upmaps(m, max_deviation=1,
+                                 max_iterations=15, wrapper=w, seed=7)
+        results.append((changed, dict(m.pg_upmap_items)))
+    assert results[0][0] > 0
+    assert results[0] == results[1]

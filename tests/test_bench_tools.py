@@ -95,3 +95,33 @@ def test_bench_init_probe_fail_fast():
     finally:
         proc.kill()
         proc.wait()
+
+
+def test_bench_worker_balancer_smoke(tmp_path, monkeypatch, capsys):
+    """The balancer bench lane end-to-end on a shrunk synthetic map:
+    the record lands with the convergence trajectory perf_history
+    ingests (kind/rounds/stddevs/sweep rate), and the offline loop
+    actually converged."""
+    import json
+
+    import bench
+
+    out = tmp_path / "BALANCE_r99.json"
+    monkeypatch.setenv("CEPH_TPU_BALANCE_OSDS", "32")
+    monkeypatch.setenv("CEPH_TPU_BALANCE_PGS", "128")
+    monkeypatch.setenv("CEPH_TPU_BALANCE_SEED", "1")
+    monkeypatch.setenv("CEPH_TPU_BALANCE_ITERS", "30")
+    monkeypatch.setenv("CEPH_TPU_BALANCE_ROUNDS", "8")
+    monkeypatch.setenv("CEPH_TPU_BALANCE_MAX_DEVIATION", "2")
+    monkeypatch.setenv("CEPH_TPU_BALANCE_OUT", str(out))
+    bench.worker_balancer()
+    lines = [json.loads(ln.split(" ", 1)[1])
+             for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("BENCH_RESULT ")]
+    assert any(r.get("stage") == "balancer" for r in lines)
+    rec = json.loads(out.read_text())
+    assert rec["kind"] == "balance"
+    assert rec["converged"]
+    assert rec["final_stddev"] <= rec["initial_stddev"]
+    assert rec["sweep_mappings_per_sec"] > 0
+    assert rec["rounds"] >= 1 and rec["upmaps"] > 0

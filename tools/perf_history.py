@@ -56,6 +56,9 @@ METRICS = [
     ("init_probe_s", False),
     ("chaos_ops", True),
     ("chaos_converge_s", False),
+    ("balance_rounds", False),
+    ("balance_final_stddev", False),
+    ("balance_sweep_mappings_s", True),
 ]
 
 _TAIL_PATTERNS = {
@@ -191,6 +194,30 @@ def load_chaos(path: str) -> Optional[Dict]:
     return {"metrics": metrics, "fail": fail}
 
 
+def load_balance(path: str) -> Optional[Dict]:
+    """One BALANCE_rNN.json balancer-convergence record (bench.py
+    --worker balancer over ceph_tpu/mgr/run_offline): rounds to
+    converge, final deviation stddev, sweep throughput.  A run that
+    exits without converging is a red check outright."""
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    metrics: Dict[str, float] = {}
+    if isinstance(raw.get("rounds"), (int, float)):
+        metrics["balance_rounds"] = float(raw["rounds"])
+    if isinstance(raw.get("final_stddev"), (int, float)):
+        metrics["balance_final_stddev"] = float(raw["final_stddev"])
+    if isinstance(raw.get("sweep_mappings_per_sec"), (int, float)):
+        metrics["balance_sweep_mappings_s"] = float(
+            raw["sweep_mappings_per_sec"])
+    fail: List[str] = []
+    if raw.get("converged") is False:
+        fail.append("balance_not_converged")
+    return {"metrics": metrics, "fail": fail}
+
+
 def load_all(directory: str) -> List[Dict]:
     rows = []
     for path in sorted(glob.glob(os.path.join(directory,
@@ -241,6 +268,27 @@ def load_all(directory: str) -> List[Dict]:
         for k, v in ch["metrics"].items():
             row["metrics"].setdefault(k, v)
         row["slo_fail"].extend(ch["fail"])
+    # BALANCE_rNN balancer-convergence records: placement-quality
+    # metrics merge onto the same-numbered row; a non-converged run
+    # rides slo_fail into the regression check
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "BALANCE_r*.json"))):
+        m = re.search(r"BALANCE_r(\d+)\.json$", path)
+        bal = load_balance(path)
+        if bal is None or m is None or \
+                not (bal["metrics"] or bal["fail"]):
+            continue
+        n = int(m.group(1))
+        row = by_n.get(n)
+        if row is None:
+            row = {"run": f"r{n:02d}", "n": n,
+                   "path": os.path.basename(path), "rc": None,
+                   "platform": None, "metrics": {}, "slo_fail": []}
+            by_n[n] = row
+            rows.append(row)
+        for k, v in bal["metrics"].items():
+            row["metrics"].setdefault(k, v)
+        row["slo_fail"].extend(bal["fail"])
     rows.sort(key=lambda r: r["n"])
     return rows
 

@@ -73,6 +73,10 @@ def _conf() -> Config:
     c.set("mon_osd_down_out_interval", 1.5)
     c.set("mon_lease", 0.3)
     c.set("mon_election_timeout", 0.5)
+    # the balancer rides the soak with a tight loop and deviation
+    # target so its pause gate is exercised while OSDs flap
+    c.set("balancer_interval", 1.0)
+    c.set("balancer_max_deviation", 1)
     return c
 
 
@@ -185,6 +189,12 @@ def soak(seed: int = 0, duration: float = 20.0, n_osds: int = 5,
                    _Writer(c, 2, 2, ec=True)]
         for w in writers:
             w.start()
+        # an ACTIVE balancer rides the whole soak: its pause gate
+        # (no upmap proposals while the cluster is degraded) is a
+        # robustness invariant this soak asserts below
+        mgr = c.start_mgr()
+        bal = mgr.modules["balancer"]
+        bal.active = True
         c.set_faults(spec)
 
         end = time.monotonic() + duration
@@ -236,6 +246,13 @@ def soak(seed: int = 0, duration: float = 20.0, n_osds: int = 5,
                  for p in spec.split(";") if p.strip()]
         result["unfired_armed"] = sorted(
             n for n in armed if not result["fired"].get(n))
+        result["balancer_rounds"] = bal.rounds
+        result["balancer_pauses"] = int(
+            mgr.pc.dump().get("balancer_paused", 0))
+        result["balancer_proposals"] = sum(
+            p["proposed"] for p in bal.proposal_log)
+        result["balancer_degraded_proposals"] = sum(
+            1 for p in bal.proposal_log if p["degraded"])
     finally:
         c.shutdown()
         faults.reset()
@@ -259,7 +276,8 @@ def soak(seed: int = 0, duration: float = 20.0, n_osds: int = 5,
         result.get("lost") == 0 and converged
         and result["lockdep_violations"] == 0
         and result["span_leaks"] == 0
-        and not result["unfired_armed"])
+        and not result["unfired_armed"]
+        and result.get("balancer_degraded_proposals", 0) == 0)
     return result
 
 
