@@ -77,6 +77,10 @@ FAILPOINTS: Dict[str, str] = {
     "osd.slow_op": "shard write delayed `delay` seconds",
     "osd.shard_read_eio": "shard read returns EIO; EC reads must "
                           "decode from survivors + mark for repair",
+    # store data-corruption faults (silent bit rot on media)
+    "store.bit_rot": "one byte flipped in a store shard read; crc "
+                     "verification must catch it, degrade the read, "
+                     "and mark the shard for repair",
     # monitor faults
     "mon.drop_pg_stats": "monitor drops an incoming pg_stats beacon",
     "mon.isolate_rank": "monitor drops all mon-to-mon traffic "
@@ -284,6 +288,20 @@ def fires(name: str, who: Optional[str] = None) -> bool:
         _fired_total[name] = _fired_total.get(name, 0) + 1
     _counters().inc(name)
     return True
+
+
+def flip_byte(data: bytes) -> bytes:
+    """Seeded single-byte corruption for the ``store.bit_rot`` class
+    of faults: XOR one RNG-chosen byte with 0xFF.  The draw uses the
+    module RNG under the plane lock so a seeded run flips the same
+    offset every time."""
+    if not data:
+        return data
+    with _lock:
+        i = _rng.randrange(len(data))
+    out = bytearray(data)
+    out[i] ^= 0xFF
+    return bytes(out)
 
 
 def extra(name: str, key: str, default: float) -> float:

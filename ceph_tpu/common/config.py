@@ -158,6 +158,25 @@ OPTIONS: Dict[str, Option] = _opts(
     Option("balancer_max_iterations", int, 10,
            "calc_pg_upmaps optimizer iterations per round "
            "(upmap_max_optimizations)"),
+    Option("osd_max_recovery_ops", int, 3,
+           "recovery reservation slots per osd (local acquisitions "
+           "and remote grants share one pool — the AsyncReserver "
+           "osd_recovery_max_active role); a primary that cannot "
+           "reserve every push target backs off and retries the pass"),
+    Option("osd_recovery_sleep", float, 0.0,
+           "seconds the recovery pipeline pauses between units "
+           "(the osd_recovery_sleep pacing knob); 0 = no pacing"),
+    Option("osd_recovery_pipeline_depth", int, 2,
+           "bounded recovery pipeline depth: helper reads for up to "
+           "this many units stream while earlier units decode; "
+           "<= 1 degrades to serial gather-then-decode per unit"),
+    Option("osd_recovery_batch_max_objects", int, 8,
+           "objects batched into one recovery pipeline unit (one "
+           "concatenated recover_stripes decode)"),
+    Option("osd_recovery_helper_deadline", float, 2.0,
+           "jittered-backoff budget (seconds) for re-planning an "
+           "object's decode after helper-read failures before the "
+           "object is deferred to the next recovery pass"),
     Option("fault_inject_spec", str, "",
            "armed failpoints (analysis/faults.py spec syntax, e.g. "
            "'msgr.corrupt_frame=p:0.02;osd.slow_op=p:0.1,delay:0.05')"

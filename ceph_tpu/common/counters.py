@@ -124,6 +124,26 @@ REGISTRY: Dict[str, Dict[str, str]] = {
         "mon.drop_pg_stats": U64,
         "mon.isolate_rank": U64,
         "mgr.balancer.stale_map": U64,
+        "store.bit_rot": U64,
+    },
+    # the recovery engine (osd_service._run_recovery): pipeline shape,
+    # helper-read fan-out and exclusion accounting, reservation
+    # back-pressure, and the per-unit repair-strategy choice with the
+    # helper bytes the bandwidth-aware strategies saved over a full
+    # k-shard decode
+    "osd.recovery": {
+        "pipelined_batches": U64,
+        "serial_batches": U64,
+        "helper_reads": U64,
+        "helper_bytes": U64,
+        "helper_bytes_saved": U64,
+        "helper_eio_excluded": U64,
+        "replans": U64,
+        "strategy_full": U64,
+        "strategy_lrc": U64,
+        "strategy_clay": U64,
+        "reservation_waits": U64,
+        "remote_denials": U64,
     },
     # the manager daemon + module plane (ceph_tpu/mgr): scheduler
     # accounting plus the balancer loop's round/proposal counters and

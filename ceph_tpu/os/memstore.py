@@ -194,8 +194,14 @@ class MemStore(ObjectStore):
             if o is None:
                 raise KeyError(f"no object {cid}/{oid}")
             if length < 0:
-                return bytes(o.data[offset:])
-            return bytes(o.data[offset:offset + length])
+                out = bytes(o.data[offset:])
+            else:
+                out = bytes(o.data[offset:offset + length])
+        if faults._ACTIVE and faults.fires("store.bit_rot"):
+            # silent media corruption: the store returns success with
+            # one flipped byte — only crc verification above can tell
+            out = faults.flip_byte(out)
+        return out
 
     def stat(self, cid: str, oid: str) -> Optional[Dict]:
         with self._lock:

@@ -38,6 +38,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..analysis import faults
 from ..analysis.lockdep import make_lock, make_rlock
+from ..common.backoff import Backoff
 from ..common.context import Context
 from ..common.throttle import Throttle
 from ..ec.registry import profile_factory
@@ -51,6 +52,7 @@ from ..common.encoding import MalformedInput
 from ..common.op_queue import Requeue
 from ..common.version import NULL_VERSION, bump, make_version
 from .pg_log import PgLogEntry
+from .recovery import HelperLedger, ReservationBook
 
 
 def pg_cid(pool_id: int, ps: int) -> str:
@@ -139,6 +141,23 @@ class OSDService(MapFollower):
                     "recovered_objects", "recovery_bytes",
                     "map_epochs", "pg_stat_beacons"):
             self.pc.add_u64_counter(key)
+        # the recovery engine's own counter family (osd.recovery.*):
+        # pipeline shape, helper fan-out/exclusions, reservation
+        # back-pressure, and per-unit repair-strategy bookkeeping
+        pc = self.rec_pc = ctx.perf.create(f"osd.recovery.{osd_id}")
+        for key in ("pipelined_batches", "serial_batches",
+                    "helper_reads", "helper_bytes",
+                    "helper_bytes_saved", "helper_eio_excluded",
+                    "replans", "strategy_full", "strategy_lrc",
+                    "strategy_clay", "reservation_waits",
+                    "remote_denials"):
+            pc.add_u64_counter(key)
+        # helper-read load balancing + per-object failure exclusions,
+        # and the AsyncReserver-lite slot pool shared by local recovery
+        # work and grants to remote primaries
+        self.rec_ledger = HelperLedger()
+        self.rec_reserver = ReservationBook(
+            ctx.conf["osd_max_recovery_ops"])
         # per-PG cumulative io/recovery counters (the pg_stat_t
         # io/recovery sums): client read/write ops+bytes, EC encode
         # volume, recovery pushes — piggybacked on pg_stats beacons
@@ -155,7 +174,7 @@ class OSDService(MapFollower):
         # object store, and failure detection / remapping must not
         # head-of-line-block behind it
         control = {"map_update", "map_inc", "pg_info", "pg_poke",
-                   "pg_stray"}
+                   "pg_stray", "recovery_reserve"}
         for t, h in (("shard_write", self._h_shard_write),
                      ("shard_read", self._h_shard_read),
                      ("pg_list", self._h_pg_list),
@@ -171,6 +190,7 @@ class OSDService(MapFollower):
                      ("pg_poke", self._h_pg_poke),
                      ("pg_stray", self._h_pg_stray),
                      ("pg_log_trim", self._h_pg_log_trim),
+                     ("recovery_reserve", self._h_recovery_reserve),
                      ("pg_purge", self._h_pg_purge),
                      ("map_update", self._h_map_update),
                      ("map_inc", self._h_map_inc),
@@ -234,6 +254,9 @@ class OSDService(MapFollower):
         self._running = False
         self._recover_wake.set()
         pool = getattr(self, "_fanout_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
+        pool = getattr(self, "_recover_pool", None)
         if pool is not None:
             pool.shutdown(wait=False)
         self.sched.shutdown()
@@ -437,6 +460,8 @@ class OSDService(MapFollower):
                                  lambda: self._do_shard_read(msg))
 
     def _do_shard_read(self, msg: Dict) -> Dict:
+        from ..ec.stripe import crc32c
+
         cid = pg_cid(msg["pool"], msg["ps"])
         oid = f"{msg['oid']}.s{msg['shard']}"
         with self.optracker.create("osd_op",
@@ -446,15 +471,22 @@ class OSDService(MapFollower):
                                 f"osd.{self.id}"):
                     raise OSError("injected shard read error")
                 data = self.store.read(cid, oid)
+                stored = self.store.getattr(cid, oid, "crc")
+                if stored is not None and int(stored) != crc32c(data):
+                    # silent bit rot (store.bit_rot class): the store
+                    # returned success but the bytes are not what the
+                    # write-time digest covers — same degrade path as
+                    # an EIO'd sector
+                    raise OSError("shard crc mismatch")
             except KeyError:
                 return {"error": "enoent"}
             except OSError:
-                # a bad sector under a shard (os.read_eio or the
-                # injected arm above): the op must DEGRADE, not fail —
-                # the reader decodes from survivors ("eio" counts as
-                # reachable-but-unusable in the client's shard math),
-                # and the shard is dropped so recovery re-decodes it
-                # (the test-erasure-eio.sh flow)
+                # a bad sector under a shard (os.read_eio, bit rot, or
+                # the injected arm above): the op must DEGRADE, not
+                # fail — the reader decodes from survivors ("eio"
+                # counts as reachable-but-unusable in the client's
+                # shard math), and the shard is dropped so recovery
+                # re-decodes it (the test-erasure-eio.sh flow)
                 self.pc.inc("degraded_reads")
                 self._account_io(int(msg["pool"]), int(msg["ps"]),
                                  degraded_reads=1)
@@ -467,8 +499,19 @@ class OSDService(MapFollower):
             if self._qos_class(msg) == "client":
                 self._account_io(int(msg["pool"]), int(msg["ps"]),
                                  rd_ops=1, rd_bytes=len(data))
-            return {"data": bytes(data), "size": int(size),
-                    "v": ver.decode()}
+            out = bytes(data)
+            if msg.get("ranges"):
+                # server-side sub-chunk slicing (the CLAY bandwidth
+                # repair's network win: only the repair sub-chunks
+                # cross the wire); crc verification above always ran
+                # over the FULL shard
+                out = b"".join(out[int(off):int(off) + int(ln)]
+                               for off, ln in msg["ranges"])
+            return {"data": out, "size": int(size),
+                    "v": ver.decode(), "chunk_len": len(data),
+                    # scheduler depth: the load signal recovery
+                    # primaries feed their helper ledger with
+                    "load": sum(self.sched.depths().values())}
 
     def _h_obj_delete(self, msg: Dict) -> Dict:
         """Remove every local shard of an object and tombstone the
@@ -843,31 +886,51 @@ class OSDService(MapFollower):
 
     def _read_shard_from(self, osd: int, pool_id: int, ps: int,
                          oid: str, pos: int,
-                         qos: str = "recovery"):
+                         qos: str = "recovery",
+                         ranges: Optional[List[Tuple[int, int]]]
+                         = None):
         """One shard read, local store or peer RPC — the single fetch
         primitive behind RMW gathers and both recovery paths.
-        Returns (version, data, size) or None."""
+        ``ranges`` asks for a concatenation of (offset, length) slices
+        of the shard (the CLAY repair-sub-chunk read).  Returns
+        (version, data, size) or None."""
+        from ..ec.stripe import crc32c
+
         cid = pg_cid(pool_id, ps)
         if osd == self.id:
             try:
                 data = self.store.read(cid, f"{oid}.s{pos}")
-            except KeyError:
+            except (KeyError, OSError):
+                return None
+            stored = self.store.getattr(cid, f"{oid}.s{pos}", "crc")
+            if stored is not None and int(stored) != crc32c(data):
+                # local bit rot: unusable as a decode input — drop it
+                # for repair like the remote read path does
+                self._mark_shard_bad(pool_id, ps, oid, pos)
                 return None
             v = (self.store.getattr(cid, f"{oid}.s{pos}", "v")
                  or b"").decode()
             size = int(self.store.getattr(cid, f"{oid}.s{pos}",
                                           "size") or b"0")
+            if ranges:
+                data = b"".join(bytes(data[off:off + ln])
+                                for off, ln in ranges)
             return v, data, size
         if not self._alive(osd):
             return None
+        msg = {"type": "shard_read", "pool": pool_id, "ps": ps,
+               "oid": oid, "shard": pos, "qos_class": qos}
+        if ranges:
+            msg["ranges"] = [[int(off), int(ln)]
+                             for off, ln in ranges]
         try:
-            got = self.msgr.call(
-                self.osd_addrs[osd],
-                {"type": "shard_read", "pool": pool_id, "ps": ps,
-                 "oid": oid, "shard": pos, "qos_class": qos},
-                timeout=5)
+            got = self.msgr.call(self.osd_addrs[osd], msg, timeout=5)
         except (TimeoutError, OSError):
             return None
+        if "load" in got:
+            # the helper's scheduler depth rides every reply: the
+            # ledger's remote half of the load signal
+            self.rec_ledger.note_load(osd, got["load"])
         if "data" in got:
             return (got.get("v") or "", bytes(got["data"]),
                     int(got.get("size", 0)))
@@ -977,6 +1040,21 @@ class OSDService(MapFollower):
         """A peer lost a shard (scrub repair) or wants re-peering."""
         self._recover_wake.set()
         return None
+
+    def _h_recovery_reserve(self, msg: Dict) -> Dict:
+        """Remote recovery reservation (the AsyncReserver
+        remote_reserver surface, MRecoveryReserve role): a primary
+        about to push recovery writes at this OSD asks for a slot
+        first, so concurrent recoveries onto one OSD stay bounded by
+        ``osd_max_recovery_ops``.  Rides the control lane — a full op
+        pool must not deadlock reservation traffic."""
+        if msg.get("release"):
+            self.rec_reserver.release()
+            return {"ok": True}
+        if self.rec_reserver.try_acquire():
+            return {"ok": True, "granted": True}
+        self.rec_pc.inc("remote_denials")
+        return {"ok": True, "granted": False}
 
     # -- stray PGs (MOSDPGNotify role) ---------------------------------
     def _h_pg_stray(self, msg: Dict) -> None:
@@ -1461,6 +1539,7 @@ class OSDService(MapFollower):
         clean = True
         degraded_objs = 0  # objects needing recovery work this pass
         ec_groups: Dict[Tuple, List[Tuple[str, Dict]]] = {}
+        rep_items: List[Tuple[str, Dict]] = []
         for oid, rec in merged.items():
             if code is not None:
                 # EC: the authoritative version is the newest
@@ -1536,23 +1615,11 @@ class OSDService(MapFollower):
                 continue
             if any(shard_v(o, oid, 0) != rec["v"] for o in up):
                 degraded_objs += 1
-            if not self.backfill_throttle.get(timeout=5):
-                return
-            try:
-                clean &= self._recover_object(
-                    m, pool_id, pool, ps, up, oid, rec, infos,
-                    shard_v, code)
-            finally:
-                self.backfill_throttle.put()
-        for (need, avail, _v), items in ec_groups.items():
-            if not self.backfill_throttle.get(timeout=5):
-                return
-            try:
-                clean &= self._recover_ec_batch(
-                    pool_id, ps, up, need, avail, items, infos,
-                    shard_v, code)
-            finally:
-                self.backfill_throttle.put()
+                rep_items.append((oid, rec))
+        if rep_items or ec_groups:
+            clean &= self._run_recovery(m, pool_id, pool, ps, up,
+                                        rep_items, ec_groups, infos,
+                                        shard_v, code)
         # PG state for the monitor's PGMap/health surface
         n_alive = len([o for o in up if self._alive(o)])
         want = len(up)
@@ -1605,83 +1672,480 @@ class OSDService(MapFollower):
             .get(oid, {}).get("shards", {}) \
             .get(str(pos), NULL_VERSION)
 
-    def _recover_ec_batch(self, pool_id, ps, up, need, avail, items,
-                          infos, shard_v, code) -> bool:
-        """Batched EC recovery: every object in ``items`` shares one
-        erasure pattern, so their survivor chunks concatenate along
-        the byte axis and ONE decode launch reconstructs every lost
-        shard of every object (recover_stripes' execution model; the
-        codes are bytewise-linear, so decode(concat) == concat of
-        per-object decodes)."""
+    # -- the recovery engine (reserved, pipelined, load-balanced) ------
+    def _run_recovery(self, m, pool_id, pool, ps, up, rep_items,
+                      ec_groups, infos, shard_v, code) -> bool:
+        """One PG's recovery work for this peering pass, under the
+        reservation/throttle plane: acquire a recovery slot on every
+        alive push target (local slot + remote ``recovery_reserve``
+        grants, the AsyncReserver local/remote pair) so concurrent
+        primaries recovering onto one OSD stay bounded and client p99
+        holds; then drive replicated pulls and the pipelined EC engine
+        under the backfill throttle.  A reservation miss backs off
+        briefly (jittered) and defers the PG to the next pass —
+        recovery yields, it never stalls."""
+        pc = self.rec_pc
+        targets = sorted({o for o in list(up) + [self.id]
+                          if o == self.id or self._alive(o)})
+        granted = self._reserve_recovery(targets)
+        bo = Backoff(base=0.05, cap=0.4, deadline=1.5)
+        while granted is None:
+            pc.inc("reservation_waits")
+            if not bo.sleep():
+                return False  # contended: the periodic pass retries
+            granted = self._reserve_recovery(targets)
+        try:
+            ok = True
+            for oid, rec in rep_items:
+                if not self.backfill_throttle.get(timeout=5):
+                    return False
+                try:
+                    ok &= self._recover_object(
+                        m, pool_id, pool, ps, up, oid, rec, infos,
+                        shard_v, code)
+                finally:
+                    self.backfill_throttle.put()
+            if ec_groups:
+                if not self.backfill_throttle.get(timeout=5):
+                    return False
+                try:
+                    ok &= self._recover_ec_groups(
+                        pool_id, ps, up, ec_groups, infos, shard_v,
+                        code)
+                finally:
+                    self.backfill_throttle.put()
+            return ok
+        finally:
+            self._release_recovery(granted)
+
+    def _reserve_recovery(self, targets) -> Optional[List[int]]:
+        """All-or-nothing slot acquisition in ascending OSD order
+        (two primaries reserving each other cannot deadlock: failure
+        releases everything and backs off).  An unreachable target is
+        skipped — its pushes fail on their own; reservation must not
+        stall the reachable rest."""
+        granted: List[int] = []
+        for o in targets:
+            if o == self.id:
+                if self.rec_reserver.try_acquire():
+                    granted.append(o)
+                    continue
+                self._release_recovery(granted)
+                return None
+            try:
+                rep = self.msgr.call(
+                    self.osd_addrs[o],
+                    {"type": "recovery_reserve", "osd": self.id},
+                    timeout=5)
+            except (TimeoutError, OSError):
+                continue
+            if rep.get("granted"):
+                granted.append(o)
+            else:
+                self._release_recovery(granted)
+                return None
+        return granted
+
+    def _release_recovery(self, granted) -> None:
+        for o in granted:
+            if o == self.id:
+                self.rec_reserver.release()
+                continue
+            try:
+                self.msgr.send(self.osd_addrs[o],
+                               {"type": "recovery_reserve",
+                                "osd": self.id, "release": True})
+            except (KeyError, OSError):
+                pass
+
+    def _recovery_executor(self):
+        """Dedicated small pool for pipelined helper gathers — NOT
+        the replica fan-out pool: a gather submitting into the pool
+        its caller occupies would deadlock at depth."""
+        with self._lock:
+            ex = getattr(self, "_recover_pool", None)
+            if ex is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                ex = self._recover_pool = ThreadPoolExecutor(
+                    max_workers=4,
+                    thread_name_prefix=f"osd{self.id}-rec")
+            return ex
+
+    def _recover_ec_groups(self, pool_id, ps, up, ec_groups, infos,
+                           shard_v, code) -> bool:
+        """Pipelined multi-object EC recovery (RapidRAID's streaming
+        model, arXiv:1207.6744): erasure-pattern groups split into
+        bounded units of ``osd_recovery_batch_max_objects``; helper
+        shard reads for unit N+1 stream on the gather pool while unit
+        N's stripes decode and push on this thread.  Depth <= 1
+        degrades to serial gather-then-decode (the drill's baseline
+        knob)."""
+        import itertools
+        from collections import deque
+
+        conf = self.ctx.conf
+        pc = self.rec_pc
+        depth = int(conf["osd_recovery_pipeline_depth"])
+        batch_max = max(1, int(conf["osd_recovery_batch_max_objects"]))
+        pace = float(conf["osd_recovery_sleep"])
+        cid = pg_cid(pool_id, ps)
+        ok = True
+        units = []
+        for (need, avail, v), items in ec_groups.items():
+            strategy, plan = self._choose_ec_strategy(
+                code, need, avail, items[0][0], v, infos, shard_v)
+            if plan is None:
+                self.log.derr(
+                    f"pg {cid}: {len(items)} objects undecodable, "
+                    f"pattern need={need} avail={avail}")
+                ok = False
+                continue
+            for i in range(0, len(items), batch_max):
+                units.append((need, avail, v, strategy, plan,
+                              items[i:i + batch_max]))
+
+        def gather(unit):
+            return self._gather_ec_unit(pool_id, ps, unit, infos,
+                                        shard_v, code)
+
+        if depth <= 1:
+            for unit in units:
+                ok &= self._decode_push_ec_unit(
+                    pool_id, ps, up, unit, gather(unit), infos,
+                    shard_v, code)
+                pc.inc("serial_batches")
+                if pace > 0:
+                    time.sleep(pace)  # fault-ok: the
+                    # osd_recovery_sleep pacing knob, not retry pacing
+            return ok
+        ex = self._recovery_executor()
+        pending: deque = deque()
+        it = iter(units)
+        for unit in itertools.islice(it, depth):
+            pending.append((unit, ex.submit(gather, unit)))
+        while pending:
+            unit, fut = pending.popleft()
+            nxt = next(it, None)
+            if nxt is not None:
+                # keep `depth` gathers in flight BEFORE decoding: the
+                # next unit's helper reads overlap this unit's decode
+                pending.append((nxt, ex.submit(gather, nxt)))
+            try:
+                gathered = fut.result(timeout=60)
+            except Exception as e:
+                self.log.derr(f"pg {cid}: recovery gather failed: "
+                              f"{e!r}")
+                ok = False
+                continue
+            ok &= self._decode_push_ec_unit(
+                pool_id, ps, up, unit, gathered, infos, shard_v, code)
+            pc.inc("pipelined_batches")
+            if pace > 0:
+                time.sleep(pace)  # fault-ok: the osd_recovery_sleep
+                # pacing knob, not retry pacing
+        return ok
+
+    def _pos_load(self, oid: str, v: str, pos: int, infos,
+                  shard_v) -> float:
+        holders = [o for o in infos if shard_v(o, oid, pos) == v]
+        if not holders:
+            return float("inf")
+        return min(self.rec_ledger.load(o) for o in holders)
+
+    def _choose_ec_strategy(self, code, need, avail, rep_oid, v,
+                            infos, shard_v):
+        """Pick the repair strategy for one erasure-pattern group:
+        CLAY 1/q-bandwidth repair when the profile and loss pattern
+        allow it, LRC local-group repair when the layered minimum
+        stays under k, full decode otherwise — and for full decode,
+        prefer the k LEAST-LOADED feasible survivors over the
+        first-k-up default.  Returns (strategy, plan): the plan is a
+        sorted position list for full/lrc, the sub-chunk read plan
+        dict for clay, or None when the pattern is undecodable."""
+        k = code.get_data_chunk_count()
+        want, have = set(need), set(avail)
+        try:
+            sub = code.get_sub_chunk_count()
+        except Exception:
+            sub = 1
+        if len(want) == 1 and sub > 1 and hasattr(code, "is_repair"):
+            try:  # wire-ok: EC plan math (minimum_to_decode), not a wire decode
+                if code.is_repair(want, have):
+                    return "clay", code.minimum_to_decode(want, have)
+            except Exception:
+                pass
+        try:
+            plan = code.minimum_to_decode(want, have)
+        except Exception:
+            return "full", None
+        if len(plan) < k:
+            return "lrc", sorted(plan)
+        use = self._plan_full_use(code, want, have, rep_oid, v, infos,
+                                  shard_v)
+        return "full", use if use is not None else sorted(plan)[:k]
+
+    def _plan_full_use(self, code, want, have, rep_oid, v, infos,
+                       shard_v) -> Optional[List[int]]:
+        """Least-loaded feasible survivor set for a full decode: rank
+        positions by their best holder's ledger load and expand from
+        the cheapest k until the code accepts the candidate set (MDS
+        codes accept immediately; layered codes may need more)."""
+        k = code.get_data_chunk_count()
+        order = sorted(have, key=lambda p: (self._pos_load(
+            rep_oid, v, p, infos, shard_v), p))
+        if hasattr(code, "is_repair"):
+            # MDS by construction: any k survivors decode, and
+            # minimum_to_decode would re-route to the repair plan
+            return order[:k] if len(order) >= k else None
+        for cut in range(k, len(order) + 1):
+            try:  # wire-ok: EC plan math (minimum_to_decode), not a wire decode
+                return sorted(code.minimum_to_decode(
+                    want, set(order[:cut])))
+            except Exception:
+                continue
+        return None
+
+    def _gather_ec_unit(self, pool_id, ps, unit, infos, shard_v,
+                        code):
+        """Fetch one unit's helper shards (runs on the gather pool
+        under the pipeline).  Per object: ("batch", oid, rec, chunks)
+        for concat-decode, ("clay", oid, rec, repair) for bandwidth
+        repair, or None when no feasible plan survived this pass."""
+        need, avail, v, strategy, plan, items = unit
+        out = []
+        for oid, rec in items:
+            if strategy == "clay":
+                got = self._gather_clay_object(
+                    pool_id, ps, oid, rec, v, plan, infos, shard_v,
+                    code)
+                if got is not None:
+                    out.append(("clay", oid, rec, got))
+                    continue
+                # sub-chunk repair unavailable for THIS object
+                # (helper loss / misaligned chunk): full decode
+                use = self._plan_full_use(code, set(need), set(avail),
+                                          oid, v, infos, shard_v)
+                if use is None:
+                    out.append(None)
+                    continue
+            else:
+                use = list(plan)
+            chunks = self._gather_ec_object(
+                pool_id, ps, oid, rec, v, use, avail, need, infos,
+                shard_v, code)
+            out.append(("batch", oid, rec, chunks)
+                       if chunks is not None else None)
+        return out
+
+    def _rec_holders(self, key, oid, v, pos, infos, shard_v):
+        """Candidate holders for one shard, failure-excluded and
+        sorted least-loaded-first."""
+        excl = self.rec_ledger.excluded(key)
+        holders = [o for o in infos
+                   if o not in excl and shard_v(o, oid, pos) == v]
+        return sorted(holders,
+                      key=lambda o: (self.rec_ledger.load(o), o))
+
+    def _fetch_pos(self, key, pool_id, ps, oid, rec, v, pos, infos,
+                   shard_v, ranges=None):
+        """One position's shard from its least-loaded holder.  A
+        failed or stale read EXCLUDES that holder for this object's
+        remaining attempts (across passes — the retry-duplication
+        fix) and falls through to the next candidate."""
         import numpy as np
 
-        cid = pg_cid(pool_id, ps)
-        k = code.get_data_chunk_count()
-        use = list(avail)[:k] if len(avail) >= k else []
-        if not use:
-            self.log.derr(f"pg {cid}: {len(items)} objects with only "
-                          f"{len(avail)} shards reachable")
-            return False
-
-        def read_pos(oid, v, pos):
-            for o in infos:
-                if shard_v(o, oid, pos) != v:
-                    continue
-                rep = self._read_shard_from(o, pool_id, ps, oid, pos)
-                if rep is not None and rep[0] == v:
-                    return np.frombuffer(rep[1], np.uint8), rep[2]
-            return None
-
-        # gather per-object survivor chunks; objects with a fetch
-        # failure fall out of the batch (retried next peering pass)
-        per_obj = []
-        for oid, rec in items:
-            chunks = {}
-            for pos in use:
-                got = read_pos(oid, rec["v"], pos)
-                if got is None:
-                    break
-                chunks[pos] = got[0]
+        led = self.rec_ledger
+        pc = self.rec_pc
+        for o in self._rec_holders(key, oid, v, pos, infos, shard_v):
+            led.start(o)
+            try:
+                rep = self._read_shard_from(o, pool_id, ps, oid, pos,
+                                            ranges=ranges)
+            finally:
+                led.finish(o)
+            if rep is not None and rep[0] == v:
+                pc.inc("helper_reads")
+                pc.inc("helper_bytes", len(rep[1]))
                 # the object size travels with the shard: the info
                 # record's size may describe a newer torn version
-                rec["size"] = got[1]
-            if len(chunks) == len(use):
-                per_obj.append((oid, rec, chunks))
-        ok = len(per_obj) == len(items)
-        if not per_obj:
-            return False
+                rec["size"] = rep[2]
+                return np.frombuffer(rep[1], np.uint8)
+            led.exclude(key, o)
+            pc.inc("helper_eio_excluded")
+        return None
 
-        # ONE decode launch over the concatenated byte axis
-        offsets, total = [], 0
-        for oid, rec, chunks in per_obj:
-            ln = len(next(iter(chunks.values())))
-            offsets.append((total, ln))
-            total += ln
-        surviving = {
-            pos: np.concatenate([c[pos] for _o, _r, c in per_obj])
-            for pos in use}
-        out = code.decode(set(need), surviving)
+    def _gather_ec_object(self, pool_id, ps, oid, rec, v, use, avail,
+                          need, infos, shard_v, code):
+        """One object's survivor chunks for a full/lrc decode.  When
+        a position runs out of non-excluded holders, RE-PLAN the
+        decode from the remaining survivors (jitter-paced within the
+        osd_recovery_helper_deadline budget) instead of stalling the
+        object on the failed helper."""
+        key = (pool_id, ps, oid)
+        bo = Backoff(base=0.02, cap=0.25,
+                     deadline=self.ctx.conf[
+                         "osd_recovery_helper_deadline"])
+        pending = list(use)
+        chunks: Dict[int, object] = {}
+        while pending:
+            pos = pending.pop(0)
+            arr = self._fetch_pos(key, pool_id, ps, oid, rec, v, pos,
+                                  infos, shard_v)
+            if arr is not None:
+                chunks[pos] = arr
+                continue
+            self.rec_pc.inc("replans")
+            feasible = {p for p in avail
+                        if p in chunks or self._rec_holders(
+                            key, oid, v, p, infos, shard_v)}
+            try:
+                newplan = code.minimum_to_decode(set(need), feasible)
+            except Exception:
+                return None  # not decodable this pass; retried later
+            newuse = sorted(newplan)
+            chunks = {p: c for p, c in chunks.items() if p in newuse}
+            pending = [p for p in newuse if p not in chunks]
+            if not bo.sleep():
+                return None
+        return chunks
 
-        for (oid, rec, _c), (off, ln) in zip(per_obj, offsets):
-            for pos in need:
-                osd = up[pos]
-                if osd != self.id and not self._alive(osd):
+    def _gather_clay_object(self, pool_id, ps, oid, rec, v, plan,
+                            infos, shard_v, code):
+        """CLAY 1/q-bandwidth repair gather: the first helper reads
+        FULL (establishing the chunk length), the remaining d-1 read
+        only their repair sub-chunk ranges server-side — the network
+        never carries the bytes a full decode would have."""
+        import numpy as np
+
+        key = (pool_id, ps, oid)
+        helpers = sorted(plan)
+        sub = code.get_sub_chunk_count()
+        first = helpers[0]
+        arr = self._fetch_pos(key, pool_id, ps, oid, rec, v, first,
+                              infos, shard_v)
+        if arr is None:
+            return None
+        chunk_len = len(arr)
+        if chunk_len == 0 or chunk_len % sub != 0:
+            return None
+        scs = chunk_len // sub
+        got: Dict[int, object] = {}
+        read_bytes = chunk_len
+        for c in helpers:
+            ranges = [(int(i) * scs, int(cnt) * scs)
+                      for i, cnt in plan[c]]
+            want_len = sum(ln for _off, ln in ranges)
+            if c == first:
+                got[c] = np.concatenate(
+                    [arr[off:off + ln] for off, ln in ranges])
+                continue
+            sl = self._fetch_pos(key, pool_id, ps, oid, rec, v, c,
+                                 infos, shard_v, ranges=ranges)
+            if sl is None or len(sl) != want_len:
+                return None
+            got[c] = sl
+            read_bytes += want_len
+        k = code.get_data_chunk_count()
+        return {"helpers": got, "chunk_len": chunk_len,
+                "saved": max(0, k * chunk_len - read_bytes)}
+
+    def _decode_push_ec_unit(self, pool_id, ps, up, unit, gathered,
+                             infos, shard_v, code) -> bool:
+        """Decode one gathered unit and push the rebuilt shards.
+        Batch entries sharing a survivor set concatenate along the
+        byte axis into ONE decode launch (recover_stripes' execution
+        model; the codes are bytewise-linear, so decode(concat) ==
+        concat of per-object decodes); clay entries repair
+        per-object with chunk_size routing into the code's
+        sub-chunk `_repair` path."""
+        import numpy as np
+
+        need, avail, v, strategy, plan, items = unit
+        pc = self.rec_pc
+        cid = pg_cid(pool_id, ps)
+        k = code.get_data_chunk_count()
+        ok = True
+        batch = []
+        for entry in gathered:
+            if entry is None:
+                ok = False
+                continue
+            if entry[0] == "clay":
+                _kind, oid, rec, got = entry
+                try:
+                    out = code.decode(set(need),
+                                      dict(got["helpers"]),
+                                      chunk_size=got["chunk_len"])
+                except Exception as e:
+                    self.log.derr(f"pg {cid}: clay repair of {oid} "
+                                  f"failed: {e!r}")
                     ok = False
                     continue
-                shard = np.asarray(out[pos], np.uint8)[off:off + ln]
-                # force+expect: the authoritative version may be LOWER
-                # than a torn never-acked shard on this member — roll
-                # it back, but only if the shard is still exactly what
-                # peering observed (a racing newer client write wins)
-                self._push_shard(pool_id, ps, osd, oid, pos,
-                                 shard.tobytes(), rec.get("size", 0),
-                                 rec["v"], force=True,
-                                 expect=shard_v(osd, oid, pos))
-            self.pc.inc("recovered_objects")
-            self._account_io(pool_id, ps, objects_recovered=1)
-        self.log.dout(5, f"pg {cid}: batch-recovered "
-                         f"{len(per_obj)} objects, pattern "
-                         f"need={need}")
+                pos = next(iter(need))
+                shard = np.asarray(out[pos], np.uint8)
+                ok &= self._push_rebuilt(pool_id, ps, up, oid, rec, v,
+                                         {pos: shard}, shard_v)
+                pc.inc("strategy_clay")
+                pc.inc("helper_bytes_saved", got["saved"])
+            else:
+                batch.append(entry[1:])
+        # bucket by survivor set: re-planned objects may have deviated
+        # from the unit's plan and need their own decode launch
+        buckets: Dict[frozenset, List] = {}
+        for oid, rec, chunks in batch:
+            buckets.setdefault(frozenset(chunks), []).append(
+                (oid, rec, chunks))
+        for useset, objs in buckets.items():
+            offsets, total = [], 0
+            for _oid, _rec, chunks in objs:
+                ln = len(next(iter(chunks.values())))
+                offsets.append((total, ln))
+                total += ln
+            surviving = {
+                pos: np.concatenate([c[pos] for _o, _r, c in objs])
+                for pos in useset}
+            try:
+                out = code.decode(set(need), surviving)
+            except Exception as e:
+                self.log.derr(f"pg {cid}: batched decode failed "
+                              f"(use={sorted(useset)}): {e!r}")
+                ok = False
+                continue
+            lrc_win = len(useset) < k
+            for (oid, rec, _c), (off, ln) in zip(objs, offsets):
+                shards = {
+                    pos: np.asarray(out[pos], np.uint8)[off:off + ln]
+                    for pos in need}
+                ok &= self._push_rebuilt(pool_id, ps, up, oid, rec,
+                                         v, shards, shard_v)
+                if lrc_win:
+                    pc.inc("strategy_lrc")
+                    pc.inc("helper_bytes_saved",
+                           (k - len(useset)) * ln)
+                else:
+                    pc.inc("strategy_full")
+        return ok
+
+    def _push_rebuilt(self, pool_id, ps, up, oid, rec, v, shards,
+                      shard_v) -> bool:
+        """Push one object's rebuilt shards to their up members.
+        force+expect: the authoritative version may be LOWER than a
+        torn never-acked shard on a member — roll it back, but only
+        if the shard is still exactly what peering observed (a racing
+        newer client write wins)."""
+        ok = True
+        for pos, shard in shards.items():
+            osd = up[pos]
+            if osd != self.id and not self._alive(osd):
+                ok = False
+                continue
+            self._push_shard(pool_id, ps, osd, oid, pos,
+                             shard.tobytes(), rec.get("size", 0), v,
+                             force=True,
+                             expect=shard_v(osd, oid, pos))
+        self.pc.inc("recovered_objects")
+        self._account_io(pool_id, ps, objects_recovered=1)
         return ok
 
     def _send_delete(self, pool_id, ps, osd, oid, v, force=False,
@@ -1705,7 +2169,7 @@ class OSDService(MapFollower):
         authoritative version (ReplicatedBackend push-pull): returns
         True when every up member holds oid@v.  EC objects never reach
         here — _peer_pg_locked routes them through the torn-write-aware
-        batched path (_recover_ec_batch)."""
+        pipelined path (_recover_ec_groups)."""
         import numpy as np
 
         assert code is None, "EC recovery goes through the batch path"

@@ -190,6 +190,9 @@ DEFAULT_COLUMNS: List[Tuple[str, str, str]] = [
     ("os.wal", "group_commits", "fsync/s"),
     ("ec.engine", "encode_ops", "ecenc/s"),
     ("client.*", "ops_aio_put", "aput/s"),
+    # active recovery: objects rebuilt per second (osd family) next
+    # to the client rates they compete with under the QoS plane
+    ("osd.*", "recovered_objects", "rec/s"),
     ("mon*", "epochs", "epo/s"),
     ("mgr*", "balancer_rounds", "bal/s"),
 ]

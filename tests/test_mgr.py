@@ -191,7 +191,9 @@ def test_balancer_proposes_upmaps_and_pauses_degraded():
                        for svc in cl.osds.values())
         _wait(_osds_observed, 30, "OSD followers observing the upmap")
         assert pgid in mgr.map.pg_upmap_items  # and the mgr itself
-        assert bal.proposal_log, "no proposal round recorded"
+        # the round logs its record after the LAST proposal commits,
+        # while the monitor map shows the first one immediately
+        _wait(lambda: bal.proposal_log, 30, "proposal round recorded")
         assert all(not p["degraded"] for p in bal.proposal_log)
 
         # kill an OSD mid-loop: the loop must pause while health

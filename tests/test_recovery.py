@@ -1,0 +1,391 @@
+"""The recovery engine — pipelined, load-balanced, failure-tolerant.
+
+The contract under test, layer by layer:
+
+  * stripe batching (ec/stripe.py recover_stripes) — the decode
+    launch recovery hangs everything on: exactly-m losses still
+    decode, per-pattern launches reconstruct byte-identically, and
+    an LRC single-shard loss plans INSIDE its local group (fewer
+    than k helpers — the locality win the strategy chooser books).
+  * helper ledger + reservations (services/recovery.py) — the
+    least-loaded fan-out's load accounting, the per-object exclusion
+    table with its doubling TTL, and the shared local/remote
+    reservation slot pool.
+  * the engine in vivo (services/osd_service.py _run_recovery) —
+    a failed helper read excludes that OSD for the object's
+    remaining attempts and the decode re-plans from remaining
+    survivors in the SAME pass; serial (depth 1) and pipelined
+    modes both reconverge and book their batch counters; mixed
+    erasure patterns in one PG pass all recover.
+  * silent bit rot (store.bit_rot) — a flipped byte on a store read
+    is caught by crc verification, degrades instead of serving
+    corrupt data, and the shard is dropped for repair.
+  * the drill plumbing (tools/thrasher.py --host-kill +
+    tools/perf_history.py) — DRILL records ingest into the
+    trajectory table and durability/SLO/pipeline-gate failures
+    red-check.
+"""
+
+import json
+import pathlib
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.analysis import faults
+from ceph_tpu.common.config import Config
+from ceph_tpu.ec.registry import factory
+from ceph_tpu.ec.stripe import recover_stripes, sinfo_for
+from ceph_tpu.services.cluster import MiniCluster
+from ceph_tpu.services.osd_service import pg_cid
+from ceph_tpu.services.recovery import (EXCLUDE_BASE_S, HelperLedger,
+                                        ReservationBook)
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+from tools import perf_history, thrasher  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _fast_conf(**over):
+    c = Config()
+    c.set("osd_heartbeat_interval", 0.2)
+    c.set("osd_heartbeat_grace", 1.2)
+    c.set("mon_osd_down_out_interval", 60.0)
+    c.set("osd_pg_stat_report_interval", 0.2)
+    for k, v in over.items():
+        c.set(k, v)
+    return c
+
+
+def _encode_obj(code, data):
+    return {i: np.asarray(v, np.uint8).ravel()
+            for i, v in code.encode(set(range(
+                code.get_chunk_count())), data).items()}
+
+
+# -- recover_stripes batching edge cases ------------------------------
+def test_recover_stripes_exactly_m_failures():
+    """The worst survivable pattern: every parity count spent — m
+    simultaneous losses decode from exactly k survivors, multi-stripe
+    runs in one launch."""
+    code = factory("jerasure", {"technique": "reed_sol_van",
+                                "k": "2", "m": "2", "w": "8"})
+    sinfo = sinfo_for(code, stripe_unit=512)
+    data = bytes(range(256)) * 16  # 4 stripes of width 1024
+    enc = _encode_obj(code, data)
+    lost = {0, 3}  # one data + one parity: exactly m
+    surviving = {i: enc[i] for i in enc if i not in lost}
+    out = recover_stripes(sinfo, code, surviving, lost)
+    for i in lost:
+        assert np.asarray(out[i], np.uint8).tobytes() == \
+            enc[i].tobytes(), f"chunk {i} drifted through recovery"
+
+
+def test_recover_stripes_mixed_patterns_decode_independently():
+    """Two erasure patterns over the same profile: each pattern is
+    its own launch (the engine buckets by survivor set) and both
+    reconstruct byte-identically — a re-planned object deviating
+    from its group's pattern must not poison the batch."""
+    code = factory("jerasure", {"technique": "reed_sol_van",
+                                "k": "2", "m": "2", "w": "8"})
+    sinfo = sinfo_for(code, stripe_unit=512)
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+    enc = _encode_obj(code, data)
+    for lost in ({1}, {2, 3}):
+        surviving = {i: enc[i] for i in enc if i not in lost}
+        out = recover_stripes(sinfo, code, surviving, lost)
+        for i in lost:
+            assert np.asarray(out[i], np.uint8).tobytes() == \
+                enc[i].tobytes()
+
+
+def test_lrc_single_loss_plans_inside_local_group():
+    """LRC's reason to exist: one lost shard repairs from its LOCAL
+    group — fewer helpers than k — and the decode from only those
+    helpers is byte-correct (what the engine's 'lrc' strategy and
+    its helper_bytes_saved booking rely on)."""
+    code = factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    k = code.get_data_chunk_count()
+    n = code.get_chunk_count()
+    plan = code.minimum_to_decode({0}, set(range(n)) - {0})
+    assert len(plan) < k, "local repair should need < k helpers"
+    data = bytes(range(256)) * 16
+    enc = _encode_obj(code, data)
+    out = code.decode({0}, {i: enc[i] for i in plan})
+    assert np.asarray(out[0], np.uint8).tobytes() == enc[0].tobytes()
+
+
+# -- helper ledger + reservation book ---------------------------------
+def test_helper_ledger_load_and_exclusion_ttl():
+    led = HelperLedger()
+    led.start(3)
+    led.start(3)
+    led.note_load(3, 1.5)
+    led.note_load(5, 9.0)
+    assert led.load(3) > led.load(1)  # in-flight counts
+    assert led.load(5) == 9.0
+    led.finish(3)
+    led.finish(3)
+
+    key = (1, 0, "obj")
+    led.exclude(key, 3)
+    assert led.excluded(key) == {3}
+    assert led.excluded((1, 0, "other")) == set()  # per-object
+    # a repeat failure doubles the TTL (capped) so the exclusion
+    # outlives the next recovery passes
+    led.exclude(key, 3)
+    _exp, ttl = led._excluded[key][3]
+    assert ttl == 2 * EXCLUDE_BASE_S
+    # expiry prunes in place
+    led._excluded[key][3] = (time.monotonic() - 1.0, ttl)
+    assert led.excluded(key) == set()
+
+
+def test_reservation_book_bounds_and_releases():
+    book = ReservationBook(2)
+    assert book.try_acquire() and book.try_acquire()
+    assert not book.try_acquire()  # slots exhausted
+    book.release()
+    assert book.try_acquire()
+    for _ in range(5):
+        book.release()  # over-release must not go negative
+    assert book.held == 0
+
+
+# -- silent bit rot (store.bit_rot) -----------------------------------
+def test_bit_rot_detected_degraded_and_repaired():
+    """A flipped byte on a store read must never reach the client:
+    crc verification catches it, the read degrades (decode from
+    survivors), ``degraded_reads`` books, and the poisoned shard is
+    dropped so recovery re-decodes it."""
+    c = MiniCluster(n_osds=4, hosts=4, config=_fast_conf()).start()
+    try:
+        c.create_ec_pool(2, "rot21",
+                         {"plugin": "jerasure",
+                          "technique": "reed_sol_van",
+                          "k": "2", "m": "1", "w": "8"}, pg_num=8)
+        cli = c.client("bitrot")
+        data = bytes(range(256)) * 8
+        cli.put(2, "rotobj", data)
+        _pool, ps, up = cli._up(2, "rotobj")
+        # global oneshot: the MemStore hook passes no who, so a
+        # who-targeted arm would never fire there
+        faults.arm("store.bit_rot", "oneshot")
+        assert cli.get(2, "rotobj") == data, \
+            "bit rot reached the client"
+        assert faults.snapshot()["store.bit_rot"] == 1
+        assert sum(svc.pc.dump().get("degraded_reads", 0)
+                   for svc in c.osds.values()) >= 1
+        # every up shard healthy again (the bad one re-decoded)
+        cid = pg_cid(2, ps)
+        deadline = time.monotonic() + 20.0
+        while time.monotonic() < deadline:
+            if all(c.osds[o].store.stat(cid, f"rotobj.s{pos}")
+                   is not None for pos, o in enumerate(up)):
+                break
+            time.sleep(0.1)
+        for pos, o in enumerate(up):
+            assert c.osds[o].store.stat(cid, f"rotobj.s{pos}") \
+                is not None, "rotted shard never repaired"
+        assert cli.get(2, "rotobj") == data
+    finally:
+        c.shutdown()
+
+
+# -- helper-read failure: exclusion + same-pass re-plan ---------------
+def test_helper_eio_excludes_osd_and_replans_same_pass():
+    """The retry-duplication fix: a helper whose read EIO'd is
+    EXCLUDED for that object's remaining attempts and the decode is
+    re-planned from the remaining survivors — recovery completes in
+    the same pass instead of hammering the failed OSD."""
+    c = MiniCluster(n_osds=4, hosts=4, config=_fast_conf()).start()
+    try:
+        c.create_ec_pool(2, "exc22",
+                         {"plugin": "jerasure",
+                          "technique": "reed_sol_van",
+                          "k": "2", "m": "2", "w": "8"}, pg_num=4)
+        cli = c.client("excl")
+        data = bytes(range(256)) * 8
+        cli.put(2, "excobj", data)
+        _pool, ps, up = cli._up(2, "excobj")
+        primary = up[0]
+        cid = pg_cid(2, ps)
+        # drop a NON-primary shard so the rebuild needs k=2 helpers,
+        # at least one of them remote — the armed EIO hits that read
+        c.repair(up[1], 2, ps, "excobj.s1")
+        assert c.osds[up[1]].store.stat(cid, "excobj.s1") is None
+        faults.arm("osd.shard_read_eio", "count", count=1)
+        deadline = time.monotonic() + 25.0
+        while time.monotonic() < deadline:
+            if c.osds[up[1]].store.stat(cid, "excobj.s1") is not None:
+                break
+            time.sleep(0.1)
+        assert c.osds[up[1]].store.stat(cid, "excobj.s1") \
+            is not None, "shard never rebuilt past the EIO'd helper"
+        rec = c.osds[primary].rec_pc.dump()
+        assert rec.get("helper_eio_excluded", 0) >= 1, \
+            "failed helper was not excluded"
+        assert rec.get("replans", 0) >= 1, \
+            "decode was not re-planned after the helper failure"
+        assert faults.snapshot().get("osd.shard_read_eio") == 1
+        assert cli.get(2, "excobj") == data
+    finally:
+        c.shutdown()
+
+
+# -- pipeline modes ---------------------------------------------------
+@pytest.mark.parametrize("depth,counter", [(1, "serial_batches"),
+                                           (3, "pipelined_batches")])
+def test_recovery_pipeline_depth_modes(depth, counter):
+    """Depth <= 1 degrades to serial gather-then-decode; depth > 1
+    streams unit N+1's helper reads while unit N decodes.  Both must
+    reconverge losslessly and book their own batch counter (the
+    drill's serial-baseline knob depends on the distinction)."""
+    conf = _fast_conf(osd_recovery_pipeline_depth=depth,
+                      osd_recovery_batch_max_objects=2)
+    c = MiniCluster(n_osds=4, hosts=4, config=conf).start()
+    try:
+        c.create_ec_pool(2, "pipe21",
+                         {"plugin": "jerasure",
+                          "technique": "reed_sol_van",
+                          "k": "2", "m": "1", "w": "8"}, pg_num=4)
+        cli = c.client(f"pipe{depth}")
+        acked = {}
+        for i in range(8):
+            val = (b"%02d!" % i) * 300
+            cli.put(2, f"p{i}", val)
+            acked[f"p{i}"] = val
+        victim = 1
+        c.kill_osd(victim)
+        c.wait_for_down(victim, timeout=20)
+        c.revive_osd(victim)  # empty store: real recovery traffic
+        c.wait_for_recovery(2, acked, timeout=30)
+        for key, val in acked.items():
+            assert cli.get(2, key) == val
+        total = sum(svc.rec_pc.dump().get(counter, 0)
+                    for svc in c.osds.values())
+        assert total >= 1, f"{counter} never booked at depth {depth}"
+    finally:
+        c.shutdown()
+
+
+def test_recovery_mixed_patterns_one_pass():
+    """Objects with DIFFERENT erasure patterns in one PG pass (shard
+    1 of one object, shard 2 of another) all recover — the engine
+    plans per pattern group and buckets decodes by survivor set."""
+    c = MiniCluster(n_osds=4, hosts=4, config=_fast_conf()).start()
+    try:
+        c.create_ec_pool(2, "mix22",
+                         {"plugin": "jerasure",
+                          "technique": "reed_sol_van",
+                          "k": "2", "m": "2", "w": "8"}, pg_num=1)
+        cli = c.client("mix")
+        acked = {}
+        for i in range(4):
+            val = (b"m%d." % i) * 256
+            cli.put(2, f"mx{i}", val)
+            acked[f"mx{i}"] = val
+        _pool, ps, up = cli._up(2, "mx0")
+        c.repair(up[1], 2, ps, "mx0.s1")
+        c.repair(up[2], 2, ps, "mx1.s2")
+        c.repair(up[1], 2, ps, "mx2.s1")
+        cid = pg_cid(2, ps)
+        deadline = time.monotonic() + 25.0
+        while time.monotonic() < deadline:
+            if (c.osds[up[1]].store.stat(cid, "mx0.s1") is not None
+                    and c.osds[up[2]].store.stat(
+                        cid, "mx1.s2") is not None
+                    and c.osds[up[1]].store.stat(
+                        cid, "mx2.s1") is not None):
+                break
+            time.sleep(0.1)
+        for oid, osd, pos in (("mx0", up[1], 1), ("mx1", up[2], 2),
+                              ("mx2", up[1], 1)):
+            assert c.osds[osd].store.stat(
+                cid, f"{oid}.s{pos}") is not None, \
+                f"{oid} shard {pos} never rebuilt"
+        for key, val in acked.items():
+            assert cli.get(2, key) == val
+    finally:
+        c.shutdown()
+
+
+# -- drill record ingestion -------------------------------------------
+def _write_drill(tmp_path, n, **over):
+    rec = {"kind": "drill", "seed": 8, "n": n,
+           "recovery_mbps": 40.0, "recovery_mbps_serial": 16.0,
+           "pipeline_speedup": 2.5, "converge_s": 3.2,
+           "lost": 0, "checked": 96,
+           "soak": {"p99_ms": 55.0,
+                    "slo": {"metric": "degraded_read_p99_ms",
+                            "limit": 250.0, "value": 55.0,
+                            "pass": True}},
+           "ok": True}
+    rec.update(over)
+    path = tmp_path / f"DRILL_r{n:02d}.json"
+    path.write_text(json.dumps(rec))
+    return rec
+
+
+def test_perf_history_ingests_drill_records(tmp_path):
+    _write_drill(tmp_path, 1)
+    rows = perf_history.load_all(str(tmp_path))
+    assert len(rows) == 1
+    m = rows[0]["metrics"]
+    assert m["drill_recovery_mbs"] == 40.0
+    assert m["drill_speedup"] == 2.5
+    assert m["drill_p99_ms"] == 55.0
+    perf_history.compute_deltas(rows)
+    assert rows[0]["regressions"] == []
+
+
+def test_perf_history_red_checks_drill_failures(tmp_path):
+    _write_drill(tmp_path, 1)
+    soak = {"p99_ms": 400.0,
+            "slo": {"metric": "degraded_read_p99_ms",
+                    "limit": 250.0, "value": 400.0, "pass": False}}
+    _write_drill(tmp_path, 2, recovery_mbps=10.0, lost=3,
+                 pipeline_speedup=1.2, converge_s=None, soak=soak,
+                 ok=False)
+    rows = perf_history.load_all(str(tmp_path))
+    perf_history.compute_deltas(rows)
+    regs = " ".join(rows[-1]["regressions"])
+    assert "drill_lost_writes=3" in regs
+    assert "drill_not_converged" in regs
+    assert "drill_slo_fail:degraded_read_p99_ms" in regs
+    assert "drill_speedup_below_1.5x" in regs
+    # the >25% recovery-MB/s drop red-checks like any throughput
+    assert any(r.startswith("drill_recovery_mbs")
+               for r in rows[-1]["regressions"])
+
+
+def test_thrasher_drill_run_numbering(tmp_path):
+    _write_drill(tmp_path, 4)
+    assert thrasher.next_run_number(str(tmp_path)) == 4
+
+
+# -- the full drill (slow: two measured clusters + a soak) ------------
+@pytest.mark.slow
+def test_host_kill_drill_end_to_end():
+    rec = thrasher.host_kill_drill(seed=8, n_objects=24,
+                                   settle_timeout=120.0)
+    assert rec["lost"] == 0
+    assert rec["converge_s"] is not None
+    assert rec.get("pipeline_speedup", 0) > 1.5, rec
+
+
+@pytest.mark.slow
+def test_degraded_read_soak_end_to_end():
+    rec = thrasher.degraded_read_soak(seed=8, duration=5.0,
+                                      settle_timeout=120.0)
+    assert rec["slo"]["pass"], rec
+    assert rec["read_errors"] == 0

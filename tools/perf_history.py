@@ -59,6 +59,9 @@ METRICS = [
     ("balance_rounds", False),
     ("balance_final_stddev", False),
     ("balance_sweep_mappings_s", True),
+    ("drill_recovery_mbs", True),
+    ("drill_speedup", True),
+    ("drill_p99_ms", False),
 ]
 
 _TAIL_PATTERNS = {
@@ -218,6 +221,43 @@ def load_balance(path: str) -> Optional[Dict]:
     return {"metrics": metrics, "fail": fail}
 
 
+def load_drill(path: str) -> Optional[Dict]:
+    """One DRILL_rNN.json whole-host-failure record (tools/thrasher.py
+    --host-kill): pipelined recovery MB/s, the speedup over the serial
+    per-object baseline, and the degraded-read soak p99 become
+    trajectory metrics.  Lost acked writes, a failed reconvergence, a
+    failed SLO, or a speedup under the 1.5x pipeline gate are
+    regressions outright."""
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"# {path}: unreadable ({e})", file=sys.stderr)
+        return None
+    metrics: Dict[str, float] = {}
+    if isinstance(raw.get("recovery_mbps"), (int, float)):
+        metrics["drill_recovery_mbs"] = float(raw["recovery_mbps"])
+    if isinstance(raw.get("pipeline_speedup"), (int, float)):
+        metrics["drill_speedup"] = float(raw["pipeline_speedup"])
+    soak = raw.get("soak") or {}
+    if isinstance(soak.get("p99_ms"), (int, float)):
+        metrics["drill_p99_ms"] = float(soak["p99_ms"])
+    fail: List[str] = []
+    if raw.get("lost"):
+        fail.append(f"drill_lost_writes={raw['lost']}")
+    if raw.get("converge_s") is None:
+        fail.append("drill_not_converged")
+    slo = soak.get("slo")
+    if isinstance(slo, dict) and slo.get("pass") is False:
+        fail.append(f"drill_slo_fail:{slo.get('metric')}")
+    speedup = raw.get("pipeline_speedup")
+    if not isinstance(speedup, (int, float)) or speedup <= 1.5:
+        fail.append("drill_speedup_below_1.5x")
+    if raw.get("ok") is False:
+        fail.append("drill_failed")
+    return {"metrics": metrics, "fail": fail}
+
+
 def load_all(directory: str) -> List[Dict]:
     rows = []
     for path in sorted(glob.glob(os.path.join(directory,
@@ -289,6 +329,28 @@ def load_all(directory: str) -> List[Dict]:
         for k, v in bal["metrics"].items():
             row["metrics"].setdefault(k, v)
         row["slo_fail"].extend(bal["fail"])
+    # DRILL_rNN whole-host-failure records: recovery-throughput and
+    # degraded-read-latency metrics merge onto the same-numbered row;
+    # durability / SLO / pipeline-gate failures ride slo_fail into
+    # the regression check
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "DRILL_r*.json"))):
+        m = re.search(r"DRILL_r(\d+)\.json$", path)
+        dr = load_drill(path)
+        if dr is None or m is None or \
+                not (dr["metrics"] or dr["fail"]):
+            continue
+        n = int(m.group(1))
+        row = by_n.get(n)
+        if row is None:
+            row = {"run": f"r{n:02d}", "n": n,
+                   "path": os.path.basename(path), "rc": None,
+                   "platform": None, "metrics": {}, "slo_fail": []}
+            by_n[n] = row
+            rows.append(row)
+        for k, v in dr["metrics"].items():
+            row["metrics"].setdefault(k, v)
+        row["slo_fail"].extend(dr["fail"])
     rows.sort(key=lambda r: r["n"])
     return rows
 

@@ -27,6 +27,17 @@ Each run emits a ``CHAOS_rNN.json`` record beside the BENCH_r*.json
 series; tools/perf_history.py ingests them into the same trajectory
 table (``chaos_ops`` / ``chaos_converge_s`` columns) and flags a run
 with lost writes or failed convergence as a regression.
+
+``--host-kill`` runs the whole-host failure drill instead: every OSD
+under one CRUSH host bucket dies at once (the failure domain the EC
+rule promises to survive), every acked write must read back degraded,
+and the host revives EMPTY so the measured traffic is pure recovery.
+The cycle runs twice — pipeline depth 1 (the serial per-object
+baseline) and the pipelined default — so the emitted
+``DRILL_rNN.json`` carries recovery MB/s for both plus the speedup
+the red-check gates (>1.5x), then a degraded-read soak races reader
+threads against active recovery with shard-read EIOs armed and gates
+the p99 against an SLO block.
 """
 
 from __future__ import annotations
@@ -42,7 +53,7 @@ import sys
 import tempfile
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
@@ -281,9 +292,345 @@ def soak(seed: int = 0, duration: float = 20.0, n_osds: int = 5,
     return result
 
 
+# -- whole-host failure drill + degraded-read soak --------------------
+
+def _drill_conf(depth: int) -> Config:
+    c = _conf()
+    # keep the killed host's OSDs IN while they are down: the drill
+    # reads degraded against the stable mapping, then revives the
+    # same OSDs empty — so the measured traffic is pure recovery
+    # pushes back onto the wiped host, not a CRUSH remap shuffle
+    c.set("mon_osd_down_out_interval", 60.0)
+    c.set("osd_recovery_pipeline_depth", depth)
+    # small units -> many of them: the pipeline's overlap (unit N+1
+    # gathering while unit N decodes) is what the speedup gate
+    # measures, and it needs units to overlap
+    c.set("osd_recovery_batch_max_objects", 2)
+    c.set("osd_recovery_sleep", 0.0)
+    return c
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def _recovery_bytes(cluster: MiniCluster) -> int:
+    return sum(int(svc.pc.dump().get("recovery_bytes", 0))
+               for svc in cluster.osds.values())
+
+
+def _host_members(cluster: MiniCluster, host: str) -> List[int]:
+    """OSD ids under one CRUSH host bucket (the blast radius of a
+    whole-host failure)."""
+    crush = cluster.wrapper
+    bucket = crush.get_item_id(host)
+    return sorted(d for d in range(cluster.n_osds)
+                  if crush.get_immediate_parent_id(d) == bucket)
+
+
+def _kill_host_phase(seed: int, depth: int, n_osds: int, hosts: int,
+                     n_objects: int, obj_bytes: int,
+                     settle_timeout: float,
+                     net_delay: float = 0.015) -> Dict:
+    """One measured whole-host kill/recover cycle at a given pipeline
+    depth: write, kill every OSD of host0, verify every acked write
+    reads back degraded, revive the host EMPTY (fresh stores — real
+    recovery traffic), and time the recovery to clean.
+
+    ``net_delay`` models per-link network latency on OSD-to-OSD
+    frames via the seeded ``msgr.delay_frame`` failpoint for the
+    timed window.  In-process loopback RTT is microseconds, which
+    hides exactly the cost the pipeline exists to overlap (helper
+    reads wait on the network in any real deployment); the delay is
+    identical at every depth, recorded in the output, and cleared
+    before the post-recovery readback."""
+    rng = random.Random(seed)
+    faults.reset()
+    faults.seed(seed)
+    c = MiniCluster(n_osds=n_osds, hosts=hosts,
+                    config=_drill_conf(depth)).start()
+    out: Dict = {"depth": depth}
+    try:
+        # k=2/m=1 with failure-domain host across `hosts` hosts: a
+        # whole-host failure costs exactly ONE shard per PG — the
+        # survivable worst case the profile promises
+        c.create_ec_pool(3, "drill21", {"plugin": "jerasure",
+                                        "technique": "reed_sol_van",
+                                        "k": "2", "m": "1", "w": "8"},
+                         pg_num=4)
+        cli = c.client(f"drill-d{depth}")
+        acked: Dict[str, bytes] = {}
+        for i in range(n_objects):
+            val = bytes(rng.randrange(256)
+                        for _ in range(7)) * (obj_bytes // 7)
+            cli.put(3, f"drill-{i}", val)
+            acked[f"drill-{i}"] = val
+
+        # expected shard layout, computed BEFORE the kill while every
+        # OSD is up (a down OSD drops out of the reported up set):
+        # placement is stable (down-out disabled, the kill never
+        # remaps), so recovery is done exactly when every shard the
+        # victims held has been rebuilt onto them
+        from ceph_tpu.osdmap.bincode_maps import payload_map
+        from ceph_tpu.services.client import object_to_ps
+
+        victims = _host_members(c, "host0")
+        m = payload_map(c.mon_command({"type": "get_map"}))
+        pool = m.pools[3]
+        expect: List[Tuple[int, str, str]] = []
+        for oid in acked:
+            ps = object_to_ps(oid) % pool.pg_num
+            up, _p, _a, _ap = m.pg_to_up_acting_osds(3, ps)
+            for pos, osd in enumerate(up):
+                if osd in victims:
+                    expect.append((osd, f"3.{ps}", f"{oid}.s{pos}"))
+
+        for o in victims:
+            c.kill_osd(o)
+        for o in victims:
+            c.wait_for_down(o, timeout=20)
+
+        # degraded reads: every ACKED write must read back from the
+        # survivors while the host is dark — zero acked-write loss
+        lost = 0
+        for key, want in acked.items():
+            try:
+                if cli.get(3, key) != want:
+                    lost += 1
+            except Exception:
+                lost += 1
+        out["lost_degraded"] = lost
+
+        # revive the whole host with EMPTY stores and time the
+        # recovery that rebuilds every lost shard from survivors.
+        # The speedup gate compares gather/decode time across pipeline
+        # depths, so the clock runs from the FIRST recovered byte to
+        # the last rebuilt victim shard — the revive/heartbeat
+        # detection latency ahead of it is identical at every depth
+        # and the harness's 0.2s poll would quantize it away.
+        if net_delay > 0:
+            c.set_faults(f"msgr.delay_frame=p:1.0,"
+                         f"delay:{net_delay},who:osd.")
+            out["net_delay_s"] = net_delay
+        base = _recovery_bytes(c)
+        t0 = time.monotonic()
+        for o in victims:
+            c.revive_osd(o)
+
+        def _rebuilt() -> bool:
+            return all(c.osds[osd].store.stat(cid, sh) is not None
+                       for osd, cid, sh in expect
+                       if osd in c.osds)
+
+        t_first = None
+        deadline = time.monotonic() + settle_timeout
+        while time.monotonic() < deadline:
+            if t_first is None and _recovery_bytes(c) > base:
+                t_first = time.monotonic()
+            if _rebuilt():
+                break
+            time.sleep(0.005)  # fault-ok: measurement poll cadence
+        t_done = time.monotonic()
+        c.set_faults("")  # readback + convergence at loopback speed
+        try:
+            c.wait_for_recovery(3, acked, timeout=settle_timeout)
+            out["detect_s"] = round((t_first or t_done) - t0, 3)
+            out["recover_s"] = round(t_done - (t_first or t0), 3)
+            c.wait_for_health_ok(timeout=settle_timeout)
+            out["converge_s"] = round(time.monotonic() - t0, 3)
+        except TimeoutError as e:
+            out["error"] = str(e)
+            return out
+        moved = _recovery_bytes(c) - base
+        out["recovered_bytes"] = moved
+        out["recovery_mbps"] = round(
+            moved / 1e6 / max(1e-9, out["recover_s"]), 3)
+
+        # post-recovery readback: recovery must hand back the same
+        # acked bytes it found
+        lost_after = 0
+        for key, want in acked.items():
+            try:
+                if cli.get(3, key) != want:
+                    lost_after += 1
+            except Exception:
+                lost_after += 1
+        out["lost"] = lost + lost_after
+        out["checked"] = len(acked)
+        rec = {}
+        for svc in c.osds.values():
+            for k_, v_ in svc.rec_pc.dump().items():
+                if isinstance(v_, (int, float)) and v_:
+                    rec[k_] = rec.get(k_, 0) + int(v_)
+        out["recovery_counters"] = rec
+    finally:
+        c.shutdown()
+        faults.reset()
+    return out
+
+
+def host_kill_drill(seed: int = 8, n_osds: int = 6, hosts: int = 3,
+                    n_objects: int = 48, obj_bytes: int = 14 * 1024,
+                    depth: int = 3, net_delay: float = 0.015,
+                    settle_timeout: float = 90.0) -> Dict:
+    """The whole-host failure drill: the same seeded kill/recover
+    cycle measured twice — once serial (pipeline depth 1, the
+    per-object gather-then-decode baseline) and once pipelined — so
+    the record carries the recovery-MB/s speedup the pipeline gate
+    red-checks (>1.5x), alongside the durability verdicts."""
+    result: Dict = {"kind": "drill", "seed": seed, "n_osds": n_osds,
+                    "hosts": hosts, "objects": n_objects,
+                    "obj_bytes": obj_bytes}
+    serial = _kill_host_phase(seed, 1, n_osds, hosts, n_objects,
+                              obj_bytes, settle_timeout,
+                              net_delay=net_delay)
+    piped = _kill_host_phase(seed, depth, n_osds, hosts, n_objects,
+                             obj_bytes, settle_timeout,
+                             net_delay=net_delay)
+    result["serial"] = serial
+    result["pipelined"] = piped
+    result["recovery_mbps_serial"] = serial.get("recovery_mbps")
+    result["recovery_mbps"] = piped.get("recovery_mbps")
+    result["converge_s"] = piped.get("converge_s")
+    result["lost"] = (serial.get("lost", 1) + piped.get("lost", 1))
+    result["checked"] = (serial.get("checked", 0)
+                         + piped.get("checked", 0))
+    if serial.get("recovery_mbps") and piped.get("recovery_mbps"):
+        result["pipeline_speedup"] = round(
+            piped["recovery_mbps"] / serial["recovery_mbps"], 3)
+    result["ok"] = bool(
+        result["lost"] == 0
+        and serial.get("converge_s") is not None
+        and piped.get("converge_s") is not None
+        and result.get("pipeline_speedup", 0) > 1.5)
+    return result
+
+
+def degraded_read_soak(seed: int = 8, duration: float = 8.0,
+                       n_osds: int = 4, n_objects: int = 48,
+                       obj_bytes: int = 14 * 1024,
+                       slo_p99_ms: float = 250.0,
+                       eio_p: float = 0.02,
+                       settle_timeout: float = 90.0) -> Dict:
+    """Degraded reads under ACTIVE recovery with helper EIOs armed:
+    one OSD dies and comes back empty; while its shards rebuild
+    (osd_recovery_sleep stretches the window), reader threads hammer
+    the pool through the degraded path with ``osd.shard_read_eio``
+    firing probabilistically.  The p99 read latency gates against the
+    SLO block — recovery must not starve clients.
+
+    The EIO arm is scoped to ONE surviving OSD: an injected shard
+    EIO drops the shard for repair, so on a k=2,m=2 pool the worst
+    case is the empty victim plus the scoped OSD's shards = exactly
+    m losses — every object stays recoverable by construction, and
+    the soak measures latency, not data loss."""
+    rng = random.Random(seed)
+    faults.reset()
+    faults.seed(seed)
+    conf = _drill_conf(depth=3)
+    # stretch recovery across the soak window so every latency sample
+    # really races active recovery pushes
+    conf.set("osd_recovery_sleep", 0.05)
+    conf.set("osd_recovery_batch_max_objects", 1)
+    c = MiniCluster(n_osds=n_osds, hosts=n_osds, config=conf).start()
+    result: Dict = {"kind": "drill_soak", "seed": seed,
+                    "duration": duration, "eio_p": eio_p}
+    try:
+        c.create_ec_pool(3, "soak22", {"plugin": "jerasure",
+                                       "technique": "reed_sol_van",
+                                       "k": "2", "m": "2", "w": "8"},
+                         pg_num=4)
+        cli = c.client("soak-w")
+        acked: Dict[str, bytes] = {}
+        for i in range(n_objects):
+            val = bytes(rng.randrange(256)
+                        for _ in range(7)) * (obj_bytes // 7)
+            cli.put(3, f"soak-{i}", val)
+            acked[f"soak-{i}"] = val
+        victim = rng.randrange(n_osds)
+        eio_osd = (victim + 1) % n_osds
+        c.kill_osd(victim)
+        c.wait_for_down(victim, timeout=20)
+        c.revive_osd(victim)  # empty store: recovery starts now
+        c.set_faults(
+            f"osd.shard_read_eio=p:{eio_p},who:osd.{eio_osd}")
+
+        lats: List[float] = []
+        errors = [0]
+        stop = threading.Event()
+
+        def reader(wid: int) -> None:
+            r = random.Random(seed * 1000 + wid)
+            rcli = c.client(f"soak-r{wid}")
+            keys = sorted(acked)
+            while not stop.is_set():
+                key = keys[r.randrange(len(keys))]
+                t0 = time.monotonic()
+                try:
+                    got = rcli.get(3, key)
+                    lats.append(time.monotonic() - t0)
+                    if got != acked[key]:
+                        errors[0] += 1
+                except Exception:
+                    errors[0] += 1
+
+        threads = [threading.Thread(target=reader, args=(w,),
+                                    daemon=True) for w in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(duration)
+        stop.set()
+        for t in threads:
+            t.join(timeout=20)
+        c.set_faults("")
+
+        lats.sort()
+        p99 = _percentile(lats, 0.99) * 1000
+        result["reads"] = len(lats)
+        result["read_errors"] = errors[0]
+        result["p50_ms"] = round(_percentile(lats, 0.50) * 1000, 3)
+        result["p99_ms"] = round(p99, 3)
+        result["fired"] = faults.snapshot()
+        result["slo"] = {"metric": "degraded_read_p99_ms",
+                         "limit": slo_p99_ms,
+                         "value": round(p99, 3),
+                         "pass": bool(lats) and p99 <= slo_p99_ms}
+        # the soak must end in a healthy cluster with zero mismatches
+        try:
+            c.wait_for_recovery(3, acked, timeout=settle_timeout)
+            c.wait_for_health_ok(timeout=settle_timeout)
+            converged = True
+        except TimeoutError as e:
+            result["error"] = str(e)
+            converged = False
+        result["ok"] = bool(result["slo"]["pass"] and converged
+                            and errors[0] == 0)
+    finally:
+        c.shutdown()
+        faults.reset()
+    return result
+
+
+def drill(seed: int = 8, soak_duration: float = 8.0,
+          slo_p99_ms: float = 250.0) -> Dict:
+    """The full DRILL record: whole-host kill cycle (serial +
+    pipelined) then the degraded-read soak, one combined verdict."""
+    rec = host_kill_drill(seed=seed)
+    rec["soak"] = degraded_read_soak(seed=seed,
+                                     duration=soak_duration,
+                                     slo_p99_ms=slo_p99_ms)
+    rec["ok"] = bool(rec["ok"] and rec["soak"]["ok"])
+    return rec
+
+
 def next_run_number(directory: str) -> int:
     """One past the newest committed record of ANY series (BENCH /
-    MULTICHIP / CHAOS) so the chaos record pairs with its PR's run."""
+    MULTICHIP / CHAOS / DRILL) so the record pairs with its PR's
+    run."""
     n = 0
     for path in glob.glob(os.path.join(directory, "*_r*.json")):
         m = re.search(r"_r(\d+)\.json$", path)
@@ -303,28 +650,51 @@ def main(argv=None) -> int:
     ap.add_argument("--mons", type=int, default=1)
     ap.add_argument("--spec", default=DEFAULT_SPEC,
                     help="fault_inject_spec to arm during the soak")
+    ap.add_argument("--host-kill", action="store_true",
+                    help="run the whole-host failure drill + "
+                         "degraded-read soak instead of the chaos "
+                         "soak (emits DRILL_rNN.json)")
+    ap.add_argument("--slo-p99-ms", type=float, default=250.0,
+                    help="degraded-read soak p99 SLO in ms "
+                         "(default 250)")
     ap.add_argument("--out", default=None,
                     help="output record path (default "
-                         "CHAOS_rNN.json, NN from the newest "
-                         "committed record)")
+                         "CHAOS_rNN.json / DRILL_rNN.json, NN from "
+                         "the newest committed record)")
     args = ap.parse_args(argv)
 
+    series = "DRILL" if args.host_kill else "CHAOS"
     out = args.out
     if out is None:
         n = next_run_number(_ROOT)
-        out = os.path.join(_ROOT, f"CHAOS_r{n:02d}.json")
+        out = os.path.join(_ROOT, f"{series}_r{n:02d}.json")
     m = re.search(r"_r(\d+)\.json$", out)
-    rec = soak(seed=args.seed, duration=args.duration,
-               n_osds=args.osds, n_mons=args.mons, spec=args.spec)
+    if args.host_kill:
+        rec = drill(seed=args.seed, slo_p99_ms=args.slo_p99_ms)
+    else:
+        rec = soak(seed=args.seed, duration=args.duration,
+                   n_osds=args.osds, n_mons=args.mons,
+                   spec=args.spec)
     rec["n"] = int(m.group(1)) if m else 0
     with open(out, "w") as f:
         json.dump(rec, f, indent=1)
         f.write("\n")
-    print(f"# chaos seed={rec['seed']} ops={rec.get('ops')} "
-          f"lost={rec.get('lost')} "
-          f"converge={rec.get('health_converge_s')}s "
-          f"fired={rec.get('fired')} -> "
-          f"{'OK' if rec['ok'] else 'FAIL'} ({out})")
+    if args.host_kill:
+        soak_rec = rec.get("soak", {})
+        print(f"# drill seed={rec['seed']} "
+              f"mbps={rec.get('recovery_mbps')} "
+              f"(serial {rec.get('recovery_mbps_serial')}, "
+              f"speedup {rec.get('pipeline_speedup')}x) "
+              f"lost={rec.get('lost')}/{rec.get('checked')} "
+              f"converge={rec.get('converge_s')}s "
+              f"soak_p99={soak_rec.get('p99_ms')}ms -> "
+              f"{'OK' if rec['ok'] else 'FAIL'} ({out})")
+    else:
+        print(f"# chaos seed={rec['seed']} ops={rec.get('ops')} "
+              f"lost={rec.get('lost')} "
+              f"converge={rec.get('health_converge_s')}s "
+              f"fired={rec.get('fired')} -> "
+              f"{'OK' if rec['ok'] else 'FAIL'} ({out})")
     return 0 if rec["ok"] else 1
 
 
