@@ -526,6 +526,9 @@ def worker_cluster():
           seq_mbps=out.get("seq", {}).get("mb_per_sec"),
           seq_p99_ms=out.get("seq", {}).get("lat_p99_ms"),
           n_osds=out.get("n_osds"),
+          attribution=out.get("attribution"),
+          copy=out.get("copy"),
+          profiler=out.get("profiler"),
           counters=_counter_deltas(c_pre, _lib_counters()),
           slo=_slo("cluster_write_iops",
                    out["write"].get("iops") or 0.0,
@@ -1005,6 +1008,24 @@ def main():
               f" IOPS ({cl_res['seq_mbps']} MB/s)", file=sys.stderr)
         print("# cluster json: " + json.dumps(cl_res),
               file=sys.stderr)
+        attr = cl_res.get("attribution") or {}
+        if attr:
+            print(f"# attribution: {attr.get('n_ops')} traced ops, "
+                  f"unattr {attr.get('unattr_pct')}% of "
+                  f"critical path, client p50 "
+                  f"{attr.get('client_p50_ms')} ms", file=sys.stderr)
+        copyb = cl_res.get("copy") or {}
+        if copyb:
+            print(f"# copy ledger: "
+                  f"{copyb.get('bytes_per_op')} bytes copied/op "
+                  f"({copyb.get('copies')} copies, sites "
+                  f"{copyb.get('sites')})", file=sys.stderr)
+        prof = cl_res.get("profiler") or {}
+        if prof:
+            print(f"# profiler: {prof.get('samples')} samples at "
+                  f"{prof.get('hz')} Hz across "
+                  f"{prof.get('daemons')} daemons, overhead "
+                  f"{prof.get('overhead_pct')}%", file=sys.stderr)
         slo = cl_res.get("slo") or {}
         if "pass" in slo:
             print(f"# slo cluster_write_iops: value "

@@ -183,6 +183,19 @@ OPTIONS: Dict[str, Option] = _opts(
            "; empty disarms everything — the ms-inject-socket-"
            "failures / filestore_debug_inject_read_err surface",
            level="dev"),
+    Option("profiler_hz", float, 100.0,
+           "wallclock sampler rate when 'profile start' names no "
+           "rate; sampling is jittered around 1/hz (the profiler is "
+           "OFF until started via the admin socket or a bench hook)"),
+    Option("profiler_max_seconds", float, 30.0,
+           "wallclock sampler auto-stop budget: a forgotten "
+           "'profile start' stops sampling after this many seconds"),
+    Option("profiler_max_stacks", int, 4096,
+           "bounded profiler retention: distinct folded stacks kept "
+           "per daemon; further stacks fold into an overflow bucket"),
+    Option("profiler_seed", int, 0,
+           "seed for the profiler's jittered sampling interval "
+           "(reproducible sample schedules across runs)", level="dev"),
 )
 
 

@@ -50,6 +50,9 @@ class Context:
                              sample_rate=self.conf["trace_sample_rate"])
         self._admin: Optional[AdminSocket] = None
         self._admin_dir = admin_dir
+        # the wallclock sampling profiler (common/profiler.py) — OFF
+        # until 'profile start' arrives on the admin socket
+        self.profiler = None
         # the daemon's counter time-series ring (dump_metrics_history)
         self._metrics_history = None
         # (option, callback) pairs to detach on shutdown — contexts may
@@ -107,6 +110,35 @@ class Context:
                     retention=self.conf["metrics_history_retention"])
                 self._metrics_history.wire(self._admin)
                 self._metrics_history.start()
+            # the wallclock sampler command plane: `profile
+            # start|stop|dump` per daemon (the reference's
+            # wallclock-profiler attach surface).  Construction is
+            # cheap; sampling only runs between start and stop.
+            from .profiler import WallclockProfiler
+
+            self.profiler = WallclockProfiler(
+                hz=self.conf["profiler_hz"],
+                max_seconds=self.conf["profiler_max_seconds"],
+                max_stacks=self.conf["profiler_max_stacks"],
+                seed=self.conf["profiler_seed"],
+                name=self.name)
+
+            def _profile(a, _prof=self.profiler):
+                sub = a.get("cmd", "dump")
+                if sub == "start":
+                    hz = a.get("hz")
+                    started = _prof.profile_start(
+                        hz=float(hz) if hz else None)
+                    return {"started": started, "hz": _prof.hz}
+                if sub == "stop":
+                    return {"stopped": _prof.profile_stop()}
+                if sub == "dump":
+                    return _prof.profile_dump()
+                return {"error": f"unknown profile cmd: {sub}"}
+
+            self._admin.register(
+                "profile", _profile,
+                "wallclock sampler: cmd=start|stop|dump [hz=N]")
         return self._admin
 
     @property
@@ -121,6 +153,9 @@ class Context:
         if self._metrics_history is not None:
             self._metrics_history.stop()
             self._metrics_history = None
+        if self.profiler is not None:
+            self.profiler.profile_stop()
+            self.profiler = None
         if self._admin is not None:
             self._admin.shutdown()
             self._admin = None

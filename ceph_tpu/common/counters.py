@@ -171,6 +171,39 @@ REGISTRY: Dict[str, Dict[str, str]] = {
         "live_buffer_bytes": GAUGE,
         "live_buffer_bytes_hw": GAUGE,
     },
+    # the byte-copy ledger (common/copytrack.py): every host-side
+    # bytes copy on the hot write path books here, per site plus the
+    # cross-site totals the daemonperf cp/op column divides.  Site
+    # names mirror copytrack.SITES (OBS002 pins the two in sync).
+    "obs.copy": {
+        "bytes_copied": U64,
+        "copies": U64,
+        "recv_bytes": U64,
+        "recv_copies": U64,
+        "send_bytes": U64,
+        "send_copies": U64,
+        "store_txn_bytes": U64,
+        "store_txn_copies": U64,
+        "ec_assembly_bytes": U64,
+        "ec_assembly_copies": U64,
+        "recovery_push_bytes": U64,
+        "recovery_push_copies": U64,
+    },
+    # the critical-path attribution plane (common/attribution.py):
+    # one histogram per named stage a folded trace tree can charge
+    # time to, plus the explicit residual.  Names mirror
+    # attribution.STAGES (OBS002 pins the two in sync).
+    "obs.latency": {
+        "client": HIST,
+        "messenger": HIST,
+        "dispatch": HIST,
+        "osd_op": HIST,
+        "encode": HIST,
+        "wal": HIST,
+        "fanout": HIST,
+        "unattributed": HIST,
+        "attributed_ops": U64,
+    },
 }
 
 

@@ -142,7 +142,18 @@ def derive_rates(samples: List[Dict]) -> Dict[str, List[Dict]]:
     """Per-counter rate series between consecutive samples, only for
     counters that changed at least once (the unchanged majority would
     bury the signal).  Monotonic timestamps; negative deltas (a
-    counter reset) clamp to 0."""
+    counter reset) clamp to 0.
+
+    Ring-wrap audit: rates are derived at READ time from whatever the
+    bounded ring currently retains — consecutive pairs of RETAINED
+    samples only (``zip(samples, samples[1:])``).  Once the ring wraps
+    past its retention, the oldest retained sample becomes the first
+    pair's LEFT endpoint; its evicted predecessor is never consulted,
+    so the first derived rate spans [oldest_retained,
+    second_oldest_retained] — a real interval — rather than a phantom
+    interval against a dropped sample.  Pinned by
+    tests/test_observability.py::test_metrics_history_ring_wrap_rates.
+    """
     if len(samples) < 2:
         return {}
     flats = [_numeric_items(s.get("perf", {})) for s in samples]

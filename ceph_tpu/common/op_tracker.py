@@ -60,12 +60,19 @@ class TrackedOp:
 
 class OpTracker:
     def __init__(self, history_size: int = 20,
-                 history_slow_threshold: float = 0.5):
+                 history_slow_threshold: float = 0.5,
+                 slow_history_size: Optional[int] = None):
         self._inflight: Dict[int, TrackedOp] = {}
         self._history: Deque[TrackedOp] = collections.deque(
             maxlen=history_size)
+        # slow ops keep their OWN bounded ring, sized independently
+        # (osd_op_history_slow_op_size vs osd_op_history_size in the
+        # reference): only ops over the threshold enter it, so a burst
+        # of fast ops can churn ``_history`` end to end without
+        # evicting the slow ops an operator is hunting
         self._slow: Deque[TrackedOp] = collections.deque(
-            maxlen=history_size)
+            maxlen=slow_history_size if slow_history_size is not None
+            else history_size)
         self.slow_threshold = history_slow_threshold
         self._lock = make_lock("optracker")
         self._served = 0

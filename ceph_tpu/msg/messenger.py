@@ -60,6 +60,7 @@ from typing import Callable, Dict, Optional, Tuple
 from ..analysis import faults
 from ..analysis import watchdog
 from ..analysis.lockdep import make_lock, make_rlock
+from ..common import copytrack
 from ..common.backoff import Backoff
 from ..common.encoding import MalformedInput
 from ..common.log import getLogger
@@ -494,6 +495,10 @@ class Messenger:
         # receipt -> handler completion (queue wait + execution)
         self.pc.add_histogram("dispatch_lat")
         self.pc.add_time("dispatch_time")
+        # the byte-copy ledger (common/copytrack.py): recv/send copy
+        # accounting books into the daemon's obs.copy counters when a
+        # collection was passed, else the process-global ones
+        self._copy_pc = copytrack.ledger(perf)
         self.session_id = uuid.uuid4().hex[:16]
         self.throttles = throttles or {}
         self._handlers: Dict[str, Handler] = {}
@@ -612,6 +617,13 @@ class Messenger:
                 msg, blobs, nbytes = got
                 self.pc.inc("bytes_in", nbytes + 4)
                 self.pc.inc("frames_in")
+                # recv copies: the preallocated payload bytearray is
+                # one full-frame copy, and each data-segment slice in
+                # decode_frame materialises its blob once more
+                copytrack.book_pc(
+                    self._copy_pc, "recv",
+                    nbytes + sum(len(b) for b in blobs),
+                    copies=1 + len(blobs))
                 try:
                     self._dispatch(conn, msg, blobs, nbytes)
                 except Exception as e:
@@ -707,6 +719,12 @@ class Messenger:
         n = _send_frame(conn, msg, self.keyring, mutate=mutate)
         self.pc.inc("bytes_out", n)
         self.pc.inc("frames_out")
+        # send copies: encode_frame's b"".join materialises the whole
+        # payload once, and the length-word concat in _send_frame
+        # copies it again — ~2x the wire size per frame, the number
+        # a zero-copy framing refactor must drive down
+        copytrack.book_pc(self._copy_pc, "send", 2 * (n - 4),
+                          copies=2)
         if faults._ACTIVE and not close_after and \
                 faults.fires("msgr.dup_frame", self.name):
             # receiver-side seq dedup (or reply-tid idempotence) must
@@ -873,6 +891,12 @@ class Messenger:
                         child_of=msg.get("trace"),
                         require_parent=True,
                         tags={"frm": msg.get("frm", "")}) as sp:
+                    if t_rx is not None:
+                        # frame receipt -> handler start: the dispatch
+                        # queue wait, split into its own attribution
+                        # stage (common/attribution.py)
+                        sp.set_tag("q_wait",
+                                   round(time.monotonic() - t_rx, 6))
                     # watchdog-visible: a handler wedged on a lock or a
                     # peer RPC shows up in dump_blocked with its stack
                     with watchdog.section(f"{self.name}:{type_}"):

@@ -19,7 +19,7 @@ from typing import Dict, List, Optional
 
 from ..analysis import faults
 from ..analysis.lockdep import make_rlock
-from ..common import encoding
+from ..common import copytrack, encoding
 from .objectstore import (ObjectStore, Transaction, OP_CLONE, OP_MKCOLL,
                           OP_OMAP_CLEAR, OP_OMAP_RMKEYS,
                           OP_OMAP_SETKEYS, OP_REMOVE, OP_RMATTR,
@@ -48,14 +48,28 @@ class TransactionError(Exception):
 
 
 class MemStore(ObjectStore):
-    def __init__(self):
+    def __init__(self, copy_coll=None):
         self._coll: Dict[str, Dict[str, _Object]] = {}
         self._lock = make_rlock("os::mem")
+        # byte-copy ledger target: a mounting daemon passes its
+        # Context's collection so store_txn bookings ride that
+        # daemon's asok perf dump; library/test use books globally
+        self._copy_pc = copytrack.ledger(copy_coll)
 
     # -- transaction application --------------------------------------
     def queue_transaction(self, txn: Transaction) -> None:
         with self._lock:  # RLock: spans prepare AND commit — atomic
             self.prepare_transaction(txn)()
+        # copy ledger: each OP_WRITE materialises its payload into
+        # the object's backing bytearray once (full replace or RMW
+        # splice).  The WAL path books its own queue_transaction —
+        # it calls prepare_transaction directly, never this method,
+        # so the two sites can't double count.
+        nbytes = sum(len(op[4]) for op in txn.ops
+                     if op[0] == OP_WRITE)
+        if nbytes:
+            copytrack.book_pc(self._copy_pc, "store_txn", nbytes,
+                              copies=1)
 
     def prepare_transaction(self, txn: Transaction):
         """Validate and stage a transaction without committing it;

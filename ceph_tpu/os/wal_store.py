@@ -36,6 +36,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..analysis import faults
 from ..analysis.lockdep import make_lock, make_rlock
+from ..common import copytrack
 from ..common.bincode import (DecodeError, Decoder, Encoder, decode_txn,
                               encode_txn)
 from ..common.encoding import MalformedInput
@@ -220,10 +221,14 @@ class _TxnWaiter:
 class WALStore(ObjectStore):
     def __init__(self, path: str, checkpoint_every_bytes: int = 1 << 24,
                  sync: bool = True, compression: str = "zlib",
-                 group_commit_max_delay_us: int = 0):
+                 group_commit_max_delay_us: int = 0, copy_coll=None):
         from ..common.compressor import Compressor
 
         self.path = path
+        # byte-copy ledger target (see MemStore.__init__): the
+        # mounting daemon's collection, or the process-global one
+        self._copy_coll = copy_coll
+        self._copy_pc = copytrack.ledger(copy_coll)
         self.log = getLogger("wal")
         # set when mount() found a checkpoint it could not decode and
         # fell back to WAL-only recovery — surfaced, not swallowed
@@ -232,7 +237,7 @@ class WALStore(ObjectStore):
         # raw: their latency is the write ack path); mount reads both
         # formats, so the option can change between runs
         self._comp = Compressor(compression)
-        self._mem = MemStore()
+        self._mem = MemStore(copy_coll=copy_coll)
         self._wal_path = os.path.join(path, "wal.log")
         self._ckpt_path = os.path.join(path, "checkpoint")
         self._wal_f = None
@@ -348,6 +353,14 @@ class WALStore(ObjectStore):
             commit()
             self._wal_bytes += len(rec)
             _pc.inc("txns")
+            # copy ledger: the journal record materialises every op
+            # payload once (encode_record above), and the MemStore
+            # commit splices write payloads into backing bytearrays
+            # once more (this path bypasses MemStore.queue_transaction
+            # and its booking — prepare_transaction is called
+            # directly, so this is the only site that counts it)
+            copytrack.book_pc(self._copy_pc, "store_txn", len(rec),
+                              copies=2)
             if self._sync:
                 waiter = _TxnWaiter()
                 self._pending.append((seq, waiter))
@@ -488,7 +501,7 @@ class WALStore(ObjectStore):
                 os.close(dirfd)
 
     def _load_checkpoint(self) -> None:
-        self._mem = MemStore()
+        self._mem = MemStore(copy_coll=self._copy_coll)
         self._seq = self._ckpt_seq = 0
         self.last_mount_error = None
         try:

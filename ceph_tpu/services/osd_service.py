@@ -38,6 +38,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..analysis import faults
 from ..analysis.lockdep import make_lock, make_rlock
+from ..common import copytrack
 from ..common.backoff import Backoff
 from ..common.context import Context
 from ..common.throttle import Throttle
@@ -141,6 +142,9 @@ class OSDService(MapFollower):
                     "recovered_objects", "recovery_bytes",
                     "map_epochs", "pg_stat_beacons"):
             self.pc.add_u64_counter(key)
+        # the byte-copy ledger (common/copytrack.py): EC input
+        # assembly and recovery pushes book their host copies here
+        self._copy_pc = copytrack.ledger(ctx.perf)
         # the recovery engine's own counter family (osd.recovery.*):
         # pipeline shape, helper fan-out/exclusions, reservation
         # back-pressure, and per-unit repair-strategy bookkeeping
@@ -205,14 +209,15 @@ class OSDService(MapFollower):
         remounts checkpoint+WAL instead of backfilling from peers (the
         reference's BlueStore+superblock restart-replay flow)."""
         if self.data_dir is None:
-            return MemStore()
+            return MemStore(copy_coll=self.ctx.perf)
         import os
 
         from ..os.wal_store import WALStore
 
         path = os.path.join(self.data_dir, f"osd.{self.id}.wal")
         st = WALStore(path, group_commit_max_delay_us=self.ctx.conf[
-            "wal_group_commit_max_delay_us"])
+            "wal_group_commit_max_delay_us"],
+            copy_coll=self.ctx.perf)
         if not os.path.exists(os.path.join(path, "checkpoint")):
             st.mkfs()
         st.mount()
@@ -371,8 +376,16 @@ class OSDService(MapFollower):
             self._send_pg_stats(pool_id, ps)
 
     def _h_shard_write(self, msg: Dict) -> Dict:
-        return self.sched.submit(self._qos_class(msg),
-                                 lambda: self._do_shard_write(msg))
+        # the scheduler worker adopts this handler's span, so the
+        # store-commit span lands under handle:shard_write instead of
+        # orphaning when the op crosses the queue
+        parent_span = self.tracer.current()
+
+        def run():
+            with self.tracer.scope(parent_span):
+                return self._do_shard_write(msg)
+
+        return self.sched.submit(self._qos_class(msg), run)
 
     def _do_shard_write(self, msg: Dict) -> Dict:
         from ..ec.stripe import crc32c
@@ -444,7 +457,12 @@ class OSDService(MapFollower):
                         shard=msg["shard"], v=v,
                         size=msg["size"]).encode_blob()})
                 op.mark_event("queued_for_store")
-                self.store.queue_transaction(txn)
+                # the WAL stage: queue_transaction through the
+                # group-commit fsync ack (attribution stage "wal")
+                with self.tracer.start_span(
+                        "store.commit", require_parent=True,
+                        tags={"bytes": len(data)}):
+                    self.store.queue_transaction(txn)
             op.mark_event("commit")
             if faults._ACTIVE and faults.fires(
                     "osd.kill_after_commit", f"osd.{self.id}"):
@@ -456,8 +474,13 @@ class OSDService(MapFollower):
         return {"ok": True, "epoch": self.epoch}
 
     def _h_shard_read(self, msg: Dict) -> Dict:
-        return self.sched.submit(self._qos_class(msg),
-                                 lambda: self._do_shard_read(msg))
+        parent_span = self.tracer.current()
+
+        def run():
+            with self.tracer.scope(parent_span):
+                return self._do_shard_read(msg)
+
+        return self.sched.submit(self._qos_class(msg), run)
 
     def _do_shard_read(self, msg: Dict) -> Dict:
         from ..ec.stripe import crc32c
@@ -801,6 +824,14 @@ class OSDService(MapFollower):
                                                  bytes(buf))
                 payloads = [np.asarray(chunks[p], np.uint8).tobytes()
                             for p in range(n)]
+            # EC input-assembly copies: the mutable merge buffer, the
+            # immutable bytes() handed to the engine, and one
+            # device->host tobytes() per chunk — the host-copy tax
+            # the zero-copy Pallas path (ROADMAP item 2) must cut
+            copytrack.book_pc(
+                self._copy_pc, "ec_assembly",
+                2 * len(buf) + sum(len(p) for p in payloads),
+                copies=2 + n)
             # distribute; a `superseded` reply means some holder has a
             # NEWER stored version our floor probe missed (our own
             # shard degraded) — counting it as landed would ack a
@@ -2232,6 +2263,10 @@ class OSDService(MapFollower):
             return None
         if qos == "recovery" and rep is not None and rep.get("ok"):
             self.pc.inc("recovery_bytes", len(msg["data"]))
+            # recovery-push copy: the decoded shard is materialised
+            # once into the push frame (bytes(data) above)
+            copytrack.book_pc(self._copy_pc, "recovery_push",
+                              len(msg["data"]), copies=1)
             self._account_io(pool_id, ps,
                              bytes_recovered=len(msg["data"]))
         return rep

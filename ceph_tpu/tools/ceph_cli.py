@@ -17,7 +17,8 @@ CLI:
         osd down ID | pool ls | pool create ID PGS SIZE |
         pool delete ID | pool-stats [ID] | progress
     python -m ceph_tpu.tools.ceph_cli --asok-dir DIR \
-        daemonperf | top | history | telemetry snapshot|prom|traces
+        daemonperf | top | history | latency |
+        telemetry snapshot|prom|traces|flame|profile
     python -m ceph_tpu.tools.ceph_cli --asok-dir DIR \
         balancer status|on|off|eval|execute |
         mgr module ls|enable|disable NAME
@@ -183,12 +184,12 @@ def main(argv=None) -> int:
     # monitor, no messenger.  `top` and `history` are the continuous
     # plane (per-daemon metrics-history rings + live rate frames).
     if args.verb[0] in ("daemonperf", "telemetry", "top",
-                        "history"):
+                        "history", "latency"):
         from . import telemetry
 
         if not args.asok_dir:
-            print("daemonperf/telemetry/top/history need --asok-dir",
-                  file=sys.stderr)
+            print("daemonperf/telemetry/top/history/latency need "
+                  "--asok-dir", file=sys.stderr)
             return 2
         if args.verb[0] == "telemetry":
             sub = args.verb[1] if len(args.verb) > 1 else "snapshot"

@@ -21,11 +21,21 @@ cluster snapshot, and render it three ways:
   (daemonperf-over-time), and ``top`` renders live rate frames with
   cluster totals (the `ceph_cli top` view).
 
+- the profiling plane (PR 13): ``latency`` folds every completed
+  client trace in the snapshot through ``common/attribution.py`` into
+  the per-stage critical-path table ("what fraction of write p99 is
+  messenger vs fsync vs encode"); ``profile`` broadcasts the
+  wallclock sampler's start/stop/dump to every daemon; ``flame``
+  merges the per-daemon folded stacks into one cluster flamegraph
+  text report.
+
 CLI:
     python -m ceph_tpu.tools.telemetry --asok-dir DIR \
         snapshot | prom | daemonperf [--interval S] [--count N] | \
         traces [--trace-id ID] [--root NAME] | \
-        history [--last N] [--json] | top [--interval S] [--count N]
+        history [--last N] [--json] | top [--interval S] [--count N] \
+        | latency [--root NAME] [--json] | flame [--json] | \
+        profile --pcmd start|stop|dump
 """
 
 from __future__ import annotations
@@ -209,20 +219,65 @@ def _column_value(perf: Dict, logger_glob: str, key: str) -> float:
     return total
 
 
+# op-throughput counters the derived cp/op column divides by —
+# every client/OSD op the byte-copy ledger can book against
+_OP_COUNTERS: List[Tuple[str, str]] = [
+    ("osd.*", "ops_w"), ("osd.*", "ops_r"),
+    ("client.*", "ops_put"), ("client.*", "ops_get"),
+    ("client.*", "ops_write"), ("client.*", "ops_delete"),
+]
+
+
+def unattr_shares(snapshot: Dict,
+                  root_prefix: str = "client.") -> Dict[str, float]:
+    """Per-daemon unattributed critical-path share: every completed
+    client trace in the snapshot is folded (common/attribution.py)
+    and charged to the daemon that reported its ROOT span — only
+    clients originate ops, so only client rows get a value."""
+    from ..common import attribution
+
+    spans = gather_spans(snapshot)
+    root_daemon: Dict[str, str] = {}
+    for s in spans:
+        if not s.get("parent_id") and \
+                (s.get("name") or "").startswith(root_prefix):
+            root_daemon.setdefault(s.get("trace_id", ""),
+                                   s.get("daemon", "?"))
+    totals: Dict[str, List[float]] = {}
+    for fold in attribution.fold_spans(spans, root_prefix):
+        daemon = root_daemon.get(fold.get("trace_id") or "")
+        if daemon is None:
+            continue
+        acc = totals.setdefault(daemon, [0.0, 0.0])
+        acc[0] += fold["stages"].get(attribution.UNATTRIBUTED, 0.0)
+        acc[1] += fold["total"]
+    return {d: (un / tot if tot > 0 else 0.0)
+            for d, (un, tot) in totals.items()}
+
+
 def daemonperf_view(prev: Dict, cur: Dict,
                     columns: Optional[List[Tuple[str, str, str]]]
-                    = None) -> str:
+                    = None, derived: bool = True) -> str:
     """`ceph daemonperf` analogue: one row per daemon, one column per
     (logger glob, key), values are deltas/second between the two
-    snapshots."""
+    snapshots.
+
+    ``derived`` appends two computed columns sourced from the PR-13
+    observability families: ``cp/op`` (delta obs.copy bytes_copied /
+    delta ops — host bytes copied per op) and ``unattr%`` (the
+    unattributed critical-path share of the daemon's completed traces
+    in the current snapshot)."""
     columns = columns or DEFAULT_COLUMNS
     dt = max(1e-9, cur.get("ts", 0) - prev.get("ts", 0))
     headers = [h for _g, _k, h in columns]
+    if derived:
+        headers = headers + ["cp/op", "unattr%"]
     width = max(8, *(len(h) + 1 for h in headers))
     name_w = max([len("daemon")] +
                  [len(d) for d in cur.get("daemons", {})]) + 1
     lines = ["daemon".ljust(name_w)
              + "".join(h.rjust(width) for h in headers)]
+    unattr = unattr_shares(cur) if derived else {}
     for daemon in sorted(cur.get("daemons", {})):
         cperf = cur["daemons"][daemon].get("perf") or {}
         pperf = (prev.get("daemons", {}).get(daemon, {})
@@ -232,6 +287,18 @@ def daemonperf_view(prev: Dict, cur: Dict,
             rate = (_column_value(cperf, lg, key)
                     - _column_value(pperf, lg, key)) / dt
             cells.append(f"{rate:.1f}".rjust(width))
+        if derived:
+            d_copied = (_column_value(cperf, "obs.copy",
+                                      "bytes_copied")
+                        - _column_value(pperf, "obs.copy",
+                                        "bytes_copied"))
+            d_ops = sum(_column_value(cperf, lg, key)
+                        - _column_value(pperf, lg, key)
+                        for lg, key in _OP_COUNTERS)
+            cells.append((f"{d_copied / d_ops:.0f}" if d_ops > 0
+                          else "-").rjust(width))
+            cells.append((f"{unattr[daemon]:.1%}"
+                          if daemon in unattr else "-").rjust(width))
         lines.append(daemon.ljust(name_w) + "".join(cells))
     return "\n".join(lines)
 
@@ -387,6 +454,58 @@ def render_trace(roots: List[Dict]) -> str:
     return "\n".join(lines)
 
 
+# -- critical-path latency attribution (PR 13) ------------------------
+
+def latency_report(snapshot: Dict,
+                   root_prefix: str = "client.") -> Dict:
+    """Fold every completed client trace in the snapshot into the
+    cluster-wide per-stage attribution report
+    (common/attribution.py): {"n_ops", "total", "stages"}."""
+    from ..common import attribution
+
+    folds = attribution.fold_spans(gather_spans(snapshot),
+                                   root_prefix)
+    agg = attribution.StageAggregator()
+    for f in folds:
+        agg.add(f)
+    return agg.report()
+
+
+# -- wallclock profiler plane (PR 13) ---------------------------------
+
+def gather_profiles(asok_dir: Optional[str] = None,
+                    paths: Optional[Dict[str, str]] = None,
+                    timeout: float = 5.0,
+                    cmd: str = "dump") -> Dict[str, Dict]:
+    """Broadcast one ``profile`` admin command (start|stop|dump) to
+    every daemon; unreachable daemons and daemons without the command
+    are skipped, not fatal."""
+    assert asok_dir is not None or paths is not None
+    targets = dict(paths or {})
+    if asok_dir is not None:
+        targets = {**discover(asok_dir), **targets}
+    out: Dict[str, Dict] = {}
+    for name, path in sorted(targets.items()):
+        try:
+            got = AdminSocket.request(path, "profile",
+                                      timeout=timeout, cmd=cmd)
+        except (OSError, ValueError):
+            continue
+        if isinstance(got, dict) and "error" not in got:
+            out[name] = got
+    return out
+
+
+def flame_view(asok_dir: Optional[str] = None,
+               paths: Optional[Dict[str, str]] = None) -> str:
+    """The merged cluster flamegraph text report: every daemon's
+    folded stacks, keyed ``daemon/role;frames``."""
+    from ..common.profiler import merge_folded, render_flame
+
+    dumps = gather_profiles(asok_dir, paths)
+    return render_flame(merge_folded(dumps))
+
+
 def span_names(roots: List[Dict]) -> List[str]:
     """Flat preorder list of span names (test/assertion helper)."""
     out: List[str] = []
@@ -408,7 +527,8 @@ def main(argv=None) -> int:
     ap.add_argument("--asok-dir", required=True,
                     help="directory of daemon *.asok sockets")
     ap.add_argument("cmd", choices=("snapshot", "prom", "traces",
-                                    "daemonperf", "history", "top"))
+                                    "daemonperf", "history", "top",
+                                    "latency", "flame", "profile"))
     ap.add_argument("--trace-id", help="traces: reassemble this id")
     ap.add_argument("--root",
                     help="traces: only traces whose root span has "
@@ -420,8 +540,27 @@ def main(argv=None) -> int:
     ap.add_argument("--last", type=int, default=None,
                     help="history: samples per daemon (default all)")
     ap.add_argument("--json", action="store_true",
-                    help="history: raw merged rings as JSON")
+                    help="history/latency/flame: raw JSON output")
+    ap.add_argument("--pcmd", choices=("start", "stop", "dump"),
+                    default="dump",
+                    help="profile: subcommand broadcast to daemons")
     args = ap.parse_args(argv)
+
+    if args.cmd == "profile":
+        acks = gather_profiles(args.asok_dir, cmd=args.pcmd)
+        if not acks:
+            print(f"no profiler-capable daemons under "
+                  f"{args.asok_dir}", file=sys.stderr)
+            return 1
+        print(json.dumps(acks, indent=1, default=str))
+        return 0
+    if args.cmd == "flame":
+        if args.json:
+            print(json.dumps(gather_profiles(args.asok_dir),
+                             indent=1, default=str))
+        else:
+            print(flame_view(args.asok_dir))
+        return 0
 
     if args.cmd == "history":
         hist = gather_history(args.asok_dir, last=args.last)
@@ -457,6 +596,20 @@ def main(argv=None) -> int:
         return 1
     if args.cmd == "snapshot":
         print(json.dumps(snap, indent=1, default=str))
+    elif args.cmd == "latency":
+        from ..common import attribution
+
+        report = latency_report(
+            snap, root_prefix=(args.root or "client."))
+        if args.json:
+            print(json.dumps(report, indent=1, default=str))
+        elif report["n_ops"] == 0:
+            print("no completed client traces in the snapshot "
+                  "(trace_sample_rate 0, or ring evicted?)",
+                  file=sys.stderr)
+            return 1
+        else:
+            print(attribution.render_report(report))
     elif args.cmd == "prom":
         sys.stdout.write(to_prometheus(snap))
     elif args.cmd == "traces":

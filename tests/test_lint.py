@@ -674,6 +674,61 @@ def test_obs_telemetry_columns_in_registry():
             f"which is not in ceph_tpu/common/counters.py")
 
 
+def test_obs002_registry_sync(monkeypatch):
+    """Every attribution stage and copy-ledger site must have its
+    registry row; dropping one (or adding a stage without the
+    counter) is an OBS002 violation, not a zero-column two PRs
+    later."""
+    from ceph_tpu.common import attribution, copytrack
+
+    assert lint_obs.lint_registry_sync() == []
+    monkeypatch.setattr(attribution, "STAGES",
+                        attribution.STAGES + ("made_up_stage",))
+    vs = lint_obs.lint_registry_sync()
+    assert [v.code for v in vs] == ["OBS002"]
+    assert "made_up_stage" in vs[0].message
+    monkeypatch.setattr(copytrack, "SITES",
+                        copytrack.SITES + ("rogue_site",))
+    vs = lint_obs.lint_registry_sync()
+    # the bogus stage + the bogus site's _bytes and _copies rows
+    assert len(vs) == 3
+    assert any("rogue_site_bytes" in v.message for v in vs)
+    assert any("rogue_site_copies" in v.message for v in vs)
+
+
+def test_obs002_profile_start_must_be_gated(tmp_path):
+    """The wallclock sampler is off by default: an unconditional
+    profile_start() in daemon code is a violation; the admin-verb
+    dispatch shape (inside an `if`) and suppressed calls pass."""
+    vs = _olint(tmp_path, """
+        prof.profile_start()
+    """)
+    assert [v.code for v in vs] == ["OBS002"]
+    vs = _olint(tmp_path, """
+        if sub == "start":
+            prof.profile_start(hz=200)
+        if enabled:
+            profile_start()
+    """)
+    assert vs == []
+    vs = _olint(tmp_path, """
+        prof.profile_start()  # obs-ok: module-level demo harness
+    """)
+    assert vs == []
+
+
+def test_obs002_profile_start_exempt_paths(tmp_path):
+    """Tests and the bench drivers start the sampler around bounded
+    bursts on purpose — exempt by path."""
+    (tmp_path / "tests").mkdir()
+    t = tmp_path / "tests" / "test_prof.py"
+    t.write_text("prof.profile_start()\n")
+    assert lint_obs.lint_file(t) == []
+    b = tmp_path / "rados_bench.py"
+    b.write_text("prof.profile_start()\n")
+    assert lint_obs.lint_file(b) == []
+
+
 def test_obs_cli_exit_status(tmp_path):
     import subprocess
     import sys

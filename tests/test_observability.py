@@ -1,0 +1,397 @@
+"""The continuous-profiling plane's unit half.
+
+Covers the pieces the MiniCluster integration test
+(tests/test_telemetry.py::test_attribution_*) composes:
+
+- critical-path attribution: fold_tree charges every instant of the
+  root interval to exactly one stage (sum == client latency by
+  construction), the q_wait carve surfaces dispatch queueing, unknown
+  spans land in ``unattributed`` instead of inflating a neighbor;
+- the wallclock sampler: off by default, bounded retention
+  (max_stacks overflow bucket, max_seconds auto-stop), role folding;
+- the byte-copy ledger: per-collection counters, idempotent creation,
+  zero-booking no-op;
+- metrics-history ring wrap: rates derive from retained samples only
+  (the derive_rates docstring pins this file's test by name);
+- op_tracker: the slow-op ring survives a fast-op burst that churns
+  the main history ring end to end.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.common import attribution, copytrack
+from ceph_tpu.common import metrics_history as mh_mod
+from ceph_tpu.common.metrics_history import MetricsHistory, derive_rates
+from ceph_tpu.common.op_tracker import OpTracker
+from ceph_tpu.common.perf_counters import PerfCountersCollection
+from ceph_tpu.common.profiler import (WallclockProfiler, merge_folded,
+                                      render_flame, thread_role)
+
+
+# ---------------------------------------------------------------------------
+# attribution: fold_tree / fold_spans / StageAggregator
+# ---------------------------------------------------------------------------
+
+def _span(name, start, dur, span_id=None, parent_id=None,
+          trace_id="t", finished=True, tags=None, children=None):
+    s = {"name": name, "start": start, "duration": dur,
+         "trace_id": trace_id, "span_id": span_id or name,
+         "parent_id": parent_id, "finished": finished,
+         "tags": tags or {}}
+    if children is not None:
+        s["children"] = children
+    return s
+
+
+def test_fold_tree_sums_to_total_across_parallel_children():
+    # client.put [0, 10ms] with encode, fan-out, handler, and WAL
+    # commit nested the way the write path nests them
+    root = _span("client.put", 0.0, 0.010, children=[
+        _span("ec.encode", 0.001, 0.002),
+        _span("call:shard_write", 0.003, 0.006, children=[
+            _span("handle:shard_write", 0.0035, 0.004, children=[
+                _span("store.commit", 0.004, 0.002),
+            ]),
+        ]),
+    ])
+    fold = attribution.fold_tree(root)
+    assert fold is not None
+    st = fold["stages"]
+    assert fold["total"] == pytest.approx(0.010)
+    # every instant charged exactly once: stage totals sum to the
+    # measured client latency by construction
+    assert sum(st.values()) == pytest.approx(fold["total"], abs=1e-12)
+    assert st["client"] == pytest.approx(0.002)   # head + tail
+    assert st["encode"] == pytest.approx(0.002)
+    assert st["fanout"] == pytest.approx(0.002)   # call minus handler
+    assert st["osd_op"] == pytest.approx(0.002)   # handler minus WAL
+    assert st["wal"] == pytest.approx(0.002)
+    assert st["unattributed"] == pytest.approx(0.0, abs=1e-12)
+
+
+def test_fold_tree_qwait_carves_dispatch_out_of_messenger():
+    root = _span("client.put", 0.0, 0.010, children=[
+        _span("call:write", 0.001, 0.008, children=[
+            _span("handle:write", 0.003, 0.004,
+                  tags={"q_wait": 0.002}),
+        ]),
+    ])
+    st = attribution.fold_tree(root)["stages"]
+    # messenger held 4ms on the timeline; 2ms of it was the dispatch
+    # queue wait the handler tagged
+    assert st["messenger"] == pytest.approx(0.002)
+    assert st["dispatch"] == pytest.approx(0.002)
+    assert st["osd_op"] == pytest.approx(0.004)
+    assert sum(st.values()) == pytest.approx(0.010, abs=1e-12)
+
+
+def test_fold_tree_qwait_clamped_to_messenger_time():
+    # a q_wait claim larger than the surrounding messenger time
+    # (overlapping parallel fan-out waits) cannot go negative
+    root = _span("client.put", 0.0, 0.010, children=[
+        _span("call:write", 0.001, 0.008, children=[
+            _span("handle:write", 0.003, 0.004,
+                  tags={"q_wait": 0.050}),
+        ]),
+    ])
+    st = attribution.fold_tree(root)["stages"]
+    assert st["messenger"] == pytest.approx(0.0, abs=1e-12)
+    assert st["dispatch"] == pytest.approx(0.004)
+    assert sum(st.values()) == pytest.approx(0.010, abs=1e-12)
+
+
+def test_fold_tree_unknown_spans_land_in_unattributed():
+    root = _span("client.get", 0.0, 0.010, children=[
+        _span("mystery.op", 0.002, 0.003),
+    ])
+    st = attribution.fold_tree(root)["stages"]
+    assert st["unattributed"] == pytest.approx(0.003)
+    assert st["client"] == pytest.approx(0.007)
+
+
+def test_fold_tree_rejects_unfinished_and_untimed_roots():
+    assert attribution.fold_tree(
+        _span("client.put", 0.0, 0.01, finished=False)) is None
+    assert attribution.fold_tree(
+        {"name": "client.put", "children": []}) is None
+
+
+def test_stage_of_mapping():
+    assert attribution.stage_of("client.put") == "client"
+    assert attribution.stage_of("call:shard_write") == "fanout"
+    assert attribution.stage_of("ec.encode") == "encode"
+    assert attribution.stage_of("store.commit") == "wal"
+    assert attribution.stage_of("call:write") == "messenger"
+    assert attribution.stage_of("send:ping") == "messenger"
+    assert attribution.stage_of("handle:write") == "osd_op"
+    assert attribution.stage_of("mystery") is None
+    assert attribution.stage_of(None) is None
+
+
+def test_fold_spans_groups_parents_and_skips_non_roots():
+    spans = [
+        # t1: a complete client trace across two "daemons"
+        _span("client.put", 100.0, 0.010, span_id="a",
+              trace_id="t1"),
+        _span("ec.encode", 100.001, 0.002, span_id="b",
+              parent_id="a", trace_id="t1"),
+        # t2: unfinished root — not folded
+        _span("client.put", 200.0, 0.010, span_id="c",
+              trace_id="t2", finished=False),
+        # t3: a non-client root (orphaned handler) — not folded
+        _span("handle:write", 300.0, 0.010, span_id="d",
+              trace_id="t3"),
+    ]
+    folds = attribution.fold_spans(spans)
+    assert len(folds) == 1
+    assert folds[0]["trace_id"] == "t1"
+    assert folds[0]["stages"]["encode"] == pytest.approx(0.002)
+    assert folds[0]["stages"]["client"] == pytest.approx(0.008)
+
+
+def test_stage_aggregator_report_shares_sum_to_one():
+    agg = attribution.StageAggregator()
+    for _ in range(4):
+        agg.add(attribution.fold_tree(
+            _span("client.put", 0.0, 0.010, children=[
+                _span("ec.encode", 0.002, 0.004),
+            ])))
+    rep = agg.report()
+    assert rep["n_ops"] == 4
+    shares = [row["share"] for row in rep["stages"].values()]
+    assert sum(shares) == pytest.approx(1.0, abs=0.01)
+    assert rep["stages"]["encode"]["share"] == pytest.approx(0.4,
+                                                            abs=0.01)
+    text = attribution.render_report(rep)
+    assert "encode" in text and "4 ops" in text
+
+
+# ---------------------------------------------------------------------------
+# wallclock profiler
+# ---------------------------------------------------------------------------
+
+def test_profiler_off_by_default_and_dump_empty():
+    prof = WallclockProfiler(name="t")
+    assert prof.running is False
+    d = prof.profile_dump()
+    assert d["running"] is False
+    assert d["samples"] == 0 and d["folded"] == []
+
+
+def test_profiler_samples_roles_and_stops():
+    stop = threading.Event()
+
+    def spin():
+        while not stop.is_set():
+            time.sleep(0.001)
+
+    t = threading.Thread(target=spin, name="mclock-w0", daemon=True)
+    t.start()
+    prof = WallclockProfiler(hz=400.0, max_seconds=10.0, name="t")
+    try:
+        assert prof.profile_start() is True
+        assert prof.profile_start() is False  # idempotent
+        time.sleep(0.15)
+        assert prof.profile_stop() is True
+        d = prof.profile_dump()
+        assert d["running"] is False
+        assert d["samples"] >= 5
+        # the worker's pool role, index trimmed, leads its lines
+        assert any(line.startswith("mclock-w;")
+                   for line in d["folded"]), d["folded"][:5]
+        # flamegraph-collapsed: "role;frame;... count"
+        stack, _, count = d["folded"][0].rpartition(" ")
+        assert int(count) >= 1 and ";" in stack
+    finally:
+        prof.profile_stop()
+        stop.set()
+        t.join(timeout=2)
+
+
+def test_profiler_bounded_retention_and_auto_stop():
+    prof = WallclockProfiler(hz=500.0, max_seconds=0.1, max_stacks=1,
+                             name="t")
+    try:
+        prof.profile_start()
+        deadline = time.monotonic() + 3.0
+        while prof.running and time.monotonic() < deadline:
+            time.sleep(0.02)
+        # max_seconds auto-stop: a forgotten `profile start` dies alone
+        assert prof.running is False
+        d = prof.profile_dump()
+        # max_stacks: beyond the cap, samples land in the explicit
+        # overflow bucket instead of growing without bound
+        distinct = {line.rpartition(" ")[0] for line in d["folded"]}
+        assert len([s for s in distinct if "<overflow>" not in s]) <= 1
+        if d["truncated"]:
+            assert any("<overflow>" in s for s in distinct)
+    finally:
+        prof.profile_stop()
+
+
+def test_thread_role_trimming():
+    assert thread_role("msgr-dispatch:osd.1_3") == "msgr-dispatch"
+    assert thread_role("mclock-w0") == "mclock-w"
+    assert thread_role("wal-commit_12") == "wal-commit"
+    assert thread_role("MainThread") == "MainThread"
+    assert thread_role("") == "?"
+
+
+def test_merge_folded_and_render_flame():
+    merged = merge_folded({
+        "osd.0": {"folded": ["mclock-w;a.py:f;b.py:g 3"]},
+        "osd.1": {"folded": ["mclock-w;a.py:f;b.py:g 2",
+                             "not an int line"]},
+    })
+    assert merged == {"osd.0/mclock-w;a.py:f;b.py:g": 3,
+                      "osd.1/mclock-w;a.py:f;b.py:g": 2}
+    text = render_flame(merged)
+    assert "5 samples" in text and "b.py:g" in text
+
+
+# ---------------------------------------------------------------------------
+# byte-copy ledger
+# ---------------------------------------------------------------------------
+
+def test_copytrack_books_site_and_rollup_counters():
+    coll = PerfCountersCollection()
+    copytrack.book("recv", 100, copies=2, coll=coll)
+    copytrack.book("ec_assembly", 50, copies=3, coll=coll)
+    d = coll.dump()[copytrack.LOGGER]
+    assert d["bytes_copied"] == 150 and d["copies"] == 5
+    assert d["recv_bytes"] == 100 and d["recv_copies"] == 2
+    assert d["ec_assembly_bytes"] == 50 and d["ec_assembly_copies"] == 3
+    assert d["send_bytes"] == 0  # every site pre-declared, reads 0
+
+
+def test_copytrack_ledger_is_per_collection_and_cached():
+    a, b = PerfCountersCollection(), PerfCountersCollection()
+    pa, pb = copytrack.ledger(a), copytrack.ledger(b)
+    assert pa is not pb
+    assert copytrack.ledger(a) is pa  # cached, not re-created
+    copytrack.book_pc(pa, "send", 10)
+    assert a.dump()[copytrack.LOGGER]["send_bytes"] == 10
+    assert b.dump()[copytrack.LOGGER]["send_bytes"] == 0
+
+
+def test_copytrack_zero_booking_is_noop():
+    coll = PerfCountersCollection()
+    copytrack.book_pc(copytrack.ledger(coll), "recv", 0, copies=0)
+    d = coll.dump()[copytrack.LOGGER]
+    assert d["bytes_copied"] == 0 and d["copies"] == 0
+
+
+# ---------------------------------------------------------------------------
+# metrics-history ring wrap (satellite 1 — pinned by the
+# derive_rates docstring)
+# ---------------------------------------------------------------------------
+
+class _FakePerf:
+    def __init__(self):
+        self.v = 0
+
+    def dump(self):
+        return {"fake": {"ops": self.v}}
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def time(self):
+        return self.t
+
+    def monotonic(self):
+        return self.t
+
+
+def test_metrics_history_ring_wrap_rates(monkeypatch):
+    """Once the bounded ring wraps, rates must pair consecutive
+    RETAINED samples only — never a phantom interval against an
+    evicted predecessor (which would report a rate spanning time the
+    ring no longer holds)."""
+    clock = _Clock()
+    monkeypatch.setattr(mh_mod, "time", clock)
+    fake = _FakePerf()
+    hist = MetricsHistory("t", perf=fake, interval=1.0, retention=4)
+    for _ in range(10):  # 10 samples into a 4-deep ring
+        fake.v += 10
+        clock.t += 1.0
+        hist.sample()
+    samples = hist.samples()
+    assert len(samples) == 4  # wrapped: only the last 4 retained
+    assert [s["perf"]["fake"]["ops"] for s in samples] == \
+        [70, 80, 90, 100]
+    rates = derive_rates(samples)["fake.ops"]
+    # 4 retained samples -> exactly 3 derived intervals; a phantom
+    # pair against an evicted sample would add a 4th (or skew the
+    # first dt across the evicted gap)
+    assert len(rates) == 3
+    for r in rates:
+        assert r["dt"] == pytest.approx(1.0)
+        assert r["rate"] == pytest.approx(10.0)
+    # the first interval's right endpoint is the SECOND-oldest
+    # retained sample — the oldest retained is only ever a left edge
+    assert rates[0]["ts"] == samples[1]["ts"]
+
+
+def test_metrics_history_dump_matches_read_time_derivation(
+        monkeypatch):
+    clock = _Clock()
+    monkeypatch.setattr(mh_mod, "time", clock)
+    fake = _FakePerf()
+    hist = MetricsHistory("t", perf=fake, interval=1.0, retention=8)
+    for _ in range(3):
+        fake.v += 5
+        clock.t += 2.0
+        hist.sample()
+    d = hist.dump()
+    assert d["n"] == 3
+    assert d["rates"]["fake.ops"] == derive_rates(d["samples"])[
+        "fake.ops"]
+    assert [r["rate"] for r in d["rates"]["fake.ops"]] == \
+        pytest.approx([2.5, 2.5])
+
+
+# ---------------------------------------------------------------------------
+# op_tracker slow-op ring (satellite 2)
+# ---------------------------------------------------------------------------
+
+def test_op_tracker_slow_ring_survives_fast_burst():
+    """The regression the dedicated ring exists to prevent: a burst
+    of fast ops used to churn the shared history ring end to end and
+    evict the slow ops an operator was hunting."""
+    trk = OpTracker(history_size=4, history_slow_threshold=0.05,
+                    slow_history_size=8)
+    slow = trk.create("osd_op", "the one that was slow")
+    slow.start -= 1.0  # backdate: duration >= threshold
+    slow.finish()
+    for i in range(20):  # fast burst wraps _history five times over
+        trk.create("osd_op", f"fast-{i}").finish()
+    hist = trk.dump_historic_ops()
+    assert hist["num_ops"] == 4
+    assert all(o["description"].startswith("fast-")
+               for o in hist["ops"])  # slow op gone from history...
+    slow_dump = trk.dump_historic_slow_ops()
+    assert slow_dump["threshold"] == pytest.approx(0.05)
+    descs = [o["description"] for o in slow_dump["ops"]]
+    assert descs == ["the one that was slow"]  # ...but kept here
+
+
+def test_op_tracker_slow_ring_sized_independently():
+    trk = OpTracker(history_size=2, history_slow_threshold=0.05,
+                    slow_history_size=3)
+    for i in range(5):
+        op = trk.create("osd_op", f"slow-{i}")
+        op.start -= 1.0
+        op.finish()
+    descs = [o["description"]
+             for o in trk.dump_historic_slow_ops()["ops"]]
+    assert descs == ["slow-2", "slow-3", "slow-4"]
+    # default: the slow ring inherits history_size
+    assert OpTracker(history_size=7)._slow.maxlen == 7

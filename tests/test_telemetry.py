@@ -375,3 +375,146 @@ def test_dump_tracing_admin_command_filters(ec_cluster):
                                  for s in only["spans"])
     one = AdminSocket.request(path, "dump_tracing", limit=1)
     assert len(one["spans"]) == 1
+
+
+# -- the continuous-profiling plane, live on the same cluster ----------------
+
+def test_attribution_fold_matches_client_latency(ec_cluster):
+    """Satellite acceptance: one EC put's fold — stages plus
+    unattributed — sums to within 10% of the latency the caller
+    measured around the call (and to the root span exactly, by
+    construction)."""
+    from ceph_tpu.common import attribution
+
+    c = ec_cluster.client("attr")
+    data = bytes(range(256)) * 8
+    c.put(2, "attr-warm", data)  # EC compile + routing out of band
+    t0 = time.monotonic()
+    c.put(2, "attr-obj", data)
+    measured = time.monotonic() - t0
+
+    snap = telemetry.cluster_snapshot(ec_cluster.asok_dir)
+    spans = telemetry.gather_spans(snap)
+    tids = [s["trace_id"] for s in spans
+            if s["name"] == "client.put"
+            and (s.get("tags") or {}).get("oid") == "attr-obj"]
+    assert tids, "the measured put left no root span in the ring"
+    mine = [s for s in spans if s["trace_id"] == tids[-1]]
+    folds = attribution.fold_spans(mine)
+    assert len(folds) == 1
+    fold = folds[0]
+    # exactly-once charging: stage totals == root wall-clock
+    assert sum(fold["stages"].values()) == pytest.approx(
+        fold["total"], rel=1e-9)
+    # and the root wall-clock is the latency the caller saw
+    assert fold["total"] == pytest.approx(measured, rel=0.10)
+    st = fold["stages"]
+    assert st["fanout"] + st["osd_op"] + st["wal"] + st["encode"] > 0
+    # the acceptance bar for the live path: unattributed stays small
+    assert st["unattributed"] < 0.15 * fold["total"]
+
+
+def test_attribution_stable_across_sample_rate(ec_cluster):
+    """Sampling is root-decided: at a fractional rate the traces that
+    ARE recorded still fold to exact sums — partial trees (a child
+    dropped while its root sampled) cannot happen."""
+    from ceph_tpu.common import attribution
+
+    ec_cluster.conf.set("trace_sample_rate", 0.5)
+    try:
+        c = ec_cluster.client("attr-half")
+        for i in range(12):
+            c.put(2, f"attr-h-{i}", b"h" * 1024)
+    finally:
+        ec_cluster.conf.set("trace_sample_rate", 1.0)
+    snap = telemetry.cluster_snapshot(ec_cluster.asok_dir)
+    spans = telemetry.gather_spans(snap)
+    sampled = {s["trace_id"] for s in spans
+               if s["name"] == "client.put"
+               and str((s.get("tags") or {}).get("oid", ""))
+               .startswith("attr-h-")}
+    # ~half of 12 sampled; all-of or none-of is a (1/2)**12 fluke
+    assert 0 < len(sampled) < 12
+    folds = attribution.fold_spans(
+        [s for s in spans if s["trace_id"] in sampled])
+    assert len(folds) == len(sampled)
+    for fold in folds:
+        assert sum(fold["stages"].values()) == pytest.approx(
+            fold["total"], rel=1e-9)
+        # a sampled trace is a COMPLETE trace: the op's cross-daemon
+        # stages are present, not lost to the fractional rate
+        assert fold["stages"]["osd_op"] + fold["stages"]["fanout"] > 0
+
+
+def test_latency_verb_live(ec_cluster, capsys):
+    c = ec_cluster.client("latv")
+    for i in range(3):
+        c.put(2, f"lat-{i}", b"y" * 512)
+    snap = telemetry.cluster_snapshot(ec_cluster.asok_dir)
+    rep = telemetry.latency_report(snap)
+    assert rep["n_ops"] >= 3
+    shares = [row["share"] for row in rep["stages"].values()]
+    assert sum(shares) == pytest.approx(1.0, abs=0.01)
+    # live acceptance: the fold names > 85% of the critical path
+    assert rep["stages"]["unattributed"]["share"] < 0.15
+    assert telemetry.main(["--asok-dir", ec_cluster.asok_dir,
+                           "latency"]) == 0
+    out = capsys.readouterr().out
+    assert "latency attribution" in out and "wal" in out
+    from ceph_tpu.tools.ceph_cli import main as ceph_main
+
+    assert ceph_main(["--asok-dir", ec_cluster.asok_dir,
+                      "latency", "--json"]) == 0
+    rep2 = json.loads(capsys.readouterr().out)
+    assert rep2["n_ops"] >= rep["n_ops"]
+
+
+def test_profile_admin_verb_and_flame(ec_cluster):
+    """The profiler is off by default on every booted daemon, runs
+    only between explicit start/stop admin commands, and its dumps
+    merge into the cluster flame view."""
+    import os
+
+    from ceph_tpu.common.admin_socket import AdminSocket
+
+    path = os.path.join(ec_cluster.asok_dir, "osd.0.asok")
+    d = AdminSocket.request(path, "profile")
+    assert d["running"] is False and d["samples"] == 0
+    st = AdminSocket.request(path, "profile", cmd="start", hz=300)
+    assert st["started"] is True and st["hz"] == 300.0
+    c = ec_cluster.client("profload")
+    for i in range(5):
+        c.put(2, f"pf-{i}", b"p" * 1024)
+    sp = AdminSocket.request(path, "profile", cmd="stop")
+    assert sp["stopped"] is True
+    d = AdminSocket.request(path, "profile")
+    assert d["running"] is False and d["samples"] > 0
+    assert any(";" in line for line in d["folded"])
+    text = telemetry.flame_view(ec_cluster.asok_dir)
+    assert "cluster wallclock profile" in text
+    assert "osd.0/" in text
+
+
+def test_daemonperf_derived_columns(ec_cluster):
+    """daemonperf satellite: the cp/op (copied bytes per served op)
+    and unattr% columns ride the derived view."""
+    c = ec_cluster.client("dpd")
+    c.put(2, "dpd-warm", b"w" * 512)  # daemon present in BOTH snaps
+    prev = telemetry.cluster_snapshot(ec_cluster.asok_dir)
+    for i in range(4):
+        c.put(2, f"dpd-{i}", b"d" * 2048)
+    time.sleep(0.05)
+    cur = telemetry.cluster_snapshot(ec_cluster.asok_dir)
+    view = telemetry.daemonperf_view(prev, cur)
+    header = view.splitlines()[0].split()
+    assert header[-2:] == ["cp/op", "unattr%"]
+    rows = {ln.split()[0]: ln.split()
+            for ln in view.splitlines()[1:]}
+    # the derived columns are LAST — parse from the end: a saturated
+    # rate cell earlier in the row can overflow its width and merge
+    # with its neighbor, shifting index-from-header addressing
+    cp = rows["client.dpd"][-2]
+    assert cp != "-" and float(cp) > 0
+    # derived=False restores the legacy schema
+    legacy = telemetry.daemonperf_view(prev, cur, derived=False)
+    assert "cp/op" not in legacy.splitlines()[0]

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Observability lint — static companion to the counter registry.
 
-One rule, enforced by tests/test_lint.py like the CONC/JAX/WIRE
+Two rules, enforced by tests/test_lint.py like the CONC/JAX/WIRE
 families:
 
 OBS001  a perf-counter declaration (``add_u64_counter``/``add_u64``/
@@ -14,6 +14,27 @@ OBS001  a perf-counter declaration (``add_u64_counter``/``add_u64``/
         exactly how daemonperf/telemetry column schemas silently
         drift from what daemons actually book — the column reads 0
         forever and nobody notices.
+
+OBS002  the continuous-profiling plane must stay in sync with the
+        registry, and the sampler must be provably off by default:
+
+        (a) every attribution stage name
+            (``ceph_tpu.common.attribution.STAGES``) must have an
+            ``obs.latency`` histogram in the registry, and every
+            copy-ledger site (``ceph_tpu.common.copytrack.SITES``)
+            must have both its ``<site>_bytes`` and ``<site>_copies``
+            counters under ``obs.copy`` — a stage/site added without
+            its registry row would fold into telemetry columns that
+            read 0 forever (the exact drift OBS001 exists to stop);
+
+        (b) a ``profile_start(...)`` call outside ``tests/`` and the
+            bench drivers (``bench.py``/``rados_bench.py``) must sit
+            lexically inside an ``if`` — the wallclock sampler is an
+            operator-triggered admin-socket verb, and an
+            unconditional start in daemon code would silently tax
+            every op in production.  Gate it (as Context's admin hook
+            does behind ``if sub == "start":``) or add
+            ``# obs-ok: <reason>``.
 
 Name resolution, in order:
 - a literal string: checked directly against the registry;
@@ -44,9 +65,14 @@ from typing import Iterable, List, Optional
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-from ceph_tpu.common.counters import all_names  # noqa: E402
+from ceph_tpu.common.counters import all_names, declared  # noqa: E402
 
 SUPPRESS_MARK = "obs-ok:"
+
+# paths allowed to call profile_start unconditionally: tests drive the
+# sampler directly, and the bench lanes switch it on around a measured
+# burst — both are deliberate, bounded, and never ship in a daemon
+PROFILE_EXEMPT_NAMES = {"bench.py", "rados_bench.py"}
 
 RECEIVERS = {"pc", "_pc"}
 DECLARE_METHODS = {"add_u64_counter", "add_u64", "add_time",
@@ -85,13 +111,21 @@ def _receiver_name(func: ast.expr) -> Optional[str]:
 
 
 class _Checker(ast.NodeVisitor):
-    def __init__(self, path: str, source: str):
+    def __init__(self, path: str, source: str,
+                 profile_exempt: bool = False):
         self.path = path
         self.lines = source.splitlines()
         self.violations: List[Violation] = []
         self.registry = all_names()
+        self.profile_exempt = profile_exempt
         # Name -> literal candidates, from enclosing `for x in (...)`
         self._loop_bindings: dict = {}
+        self._if_depth = 0
+
+    def visit_If(self, node: ast.If) -> None:
+        self._if_depth += 1
+        self.generic_visit(node)
+        self._if_depth -= 1
 
     # -- collect `for key in ("a", "b"):` bindings --------------------
     def visit_For(self, node: ast.For) -> None:
@@ -115,6 +149,17 @@ class _Checker(ast.NodeVisitor):
     def visit_Call(self, node: ast.Call) -> None:
         self.generic_visit(node)
         func = node.func
+        called = func.attr if isinstance(func, ast.Attribute) else \
+            (func.id if isinstance(func, ast.Name) else None)
+        if called == "profile_start" and not self.profile_exempt \
+                and self._if_depth == 0 \
+                and not _suppressed(self.lines, node.lineno):
+            self.violations.append(Violation(
+                "OBS002", self.path, node.lineno,
+                "unconditional profile_start() outside tests/bench — "
+                "the wallclock sampler must be off by default; gate "
+                "the call behind an `if` (admin-verb dispatch) or "
+                "add `# obs-ok: <reason>`"))
         if not isinstance(func, ast.Attribute):
             return
         if func.attr not in DECLARE_METHODS | UPDATE_METHODS:
@@ -166,6 +211,11 @@ class _Checker(ast.NodeVisitor):
             f"ceph_tpu/common/counters.py"))
 
 
+def _profile_exempt(path: pathlib.Path) -> bool:
+    return path.name in PROFILE_EXEMPT_NAMES or \
+        "tests" in path.parts
+
+
 def lint_file(path) -> List[Violation]:
     path = pathlib.Path(path)
     source = path.read_text()
@@ -174,9 +224,36 @@ def lint_file(path) -> List[Violation]:
     except SyntaxError as e:
         return [Violation("OBS000", str(path), e.lineno or 0,
                           f"syntax error: {e.msg}")]
-    checker = _Checker(str(path), source)
+    checker = _Checker(str(path), source,
+                       profile_exempt=_profile_exempt(path))
     checker.visit(tree)
     return checker.violations
+
+
+def lint_registry_sync() -> List[Violation]:
+    """OBS002(a): the attribution stages and copy-ledger sites the
+    profiling plane books by name must each have their registry row —
+    checked against the live modules, so adding a stage/site without
+    the counter (or renaming the counter out from under the stage)
+    fails the lint, not a telemetry column two PRs later."""
+    from ceph_tpu.common.attribution import STAGES  # noqa: E402
+    from ceph_tpu.common.copytrack import SITES  # noqa: E402
+    out: List[Violation] = []
+    for stage in STAGES:
+        if not declared("obs.latency", stage):
+            out.append(Violation(
+                "OBS002", "ceph_tpu/common/attribution.py", 0,
+                f"attribution stage {stage!r} has no 'obs.latency' "
+                f"histogram in ceph_tpu/common/counters.py"))
+    for site in SITES:
+        for suffix in ("_bytes", "_copies"):
+            if not declared("obs.copy", site + suffix):
+                out.append(Violation(
+                    "OBS002", "ceph_tpu/common/copytrack.py", 0,
+                    f"copy-ledger counter '{site + suffix}' is not "
+                    f"declared under 'obs.copy' in "
+                    f"ceph_tpu/common/counters.py"))
+    return out
 
 
 def lint_paths(paths: Iterable) -> List[Violation]:
@@ -196,7 +273,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = argv if argv is not None else sys.argv[1:]
     roots = args or [pathlib.Path(__file__).resolve().parent.parent
                      / "ceph_tpu"]
-    violations = lint_paths(roots)
+    violations = lint_registry_sync() + lint_paths(roots)
     for v in violations:
         print(v)
     return 1 if violations else 0

@@ -62,6 +62,9 @@ METRICS = [
     ("drill_recovery_mbs", True),
     ("drill_speedup", True),
     ("drill_p99_ms", False),
+    ("attr_unattr_pct", False),
+    ("copy_bytes_per_op", False),
+    ("prof_overhead_pct", False),
 ]
 
 _TAIL_PATTERNS = {
@@ -82,6 +85,10 @@ _INIT_HANG_LEGACY = re.compile(
 # stage JSON ("# multichip json: {...}"), MULTICHIP dryrun tails carry
 # the dryrun-sized twin ("multichip scaling: {...}")
 _MC_JSON = re.compile(r"multichip (?:json|scaling): (\{.*\})")
+# the cluster lane's stage JSON ("# cluster json: {...}") — from the
+# profiling-plane PR on it carries the attribution / copy-ledger /
+# profiler blocks alongside the IOPS headline
+_CL_JSON = re.compile(r"# cluster json: (\{.*\})")
 
 
 def _multichip_metrics(tail: str,
@@ -116,6 +123,35 @@ def _multichip_metrics(tail: str,
     return out
 
 
+def _profiling_metrics(tail: str) -> Dict[str, float]:
+    """Profiling-plane metrics from a tail's cluster JSON block —
+    all lower-is-better: the share of the client critical path the
+    attribution fold could not name (``attr_unattr_pct``), the bytes
+    the hot write path copies per acked op (``copy_bytes_per_op``),
+    and the IOPS tax of running the wallclock sampler at its default
+    rate (``prof_overhead_pct``).  Growth past the threshold is a red
+    check: unattributed share creeping up means a new untagged span
+    on the critical path; bytes/op creeping up means a new copy."""
+    m = _CL_JSON.search(tail)
+    if not m:
+        return {}
+    try:
+        d = json.loads(m.group(1))
+    except ValueError:
+        return {}
+    out: Dict[str, float] = {}
+    attr = d.get("attribution") or {}
+    if isinstance(attr.get("unattr_pct"), (int, float)):
+        out["attr_unattr_pct"] = float(attr["unattr_pct"])
+    copyb = d.get("copy") or {}
+    if isinstance(copyb.get("bytes_per_op"), (int, float)):
+        out["copy_bytes_per_op"] = float(copyb["bytes_per_op"])
+    prof = d.get("profiler") or {}
+    if isinstance(prof.get("overhead_pct"), (int, float)):
+        out["prof_overhead_pct"] = float(prof["overhead_pct"])
+    return out
+
+
 def load_run(path: str) -> Optional[Dict]:
     try:
         raw = json.load(open(path))
@@ -142,6 +178,7 @@ def load_run(path: str) -> Optional[Dict]:
         if m:
             row["metrics"][metric] = float(m.group(1))
     row["metrics"].update(_multichip_metrics(tail))
+    row["metrics"].update(_profiling_metrics(tail))
     # how long the staged lane burned before the accelerator verdict:
     # the backend-init fail-fast probe should cap this at ~60 s (the
     # r05 run burned 300 s; the probe landed after that measurement)
