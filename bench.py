@@ -515,7 +515,9 @@ def worker_cluster():
     c_pre = _lib_counters()
     out = bench_minicluster(op="seq", seconds=2.0, concurrent=8,
                             object_size=1 << 16, n_osds=4,
-                            qd_sweep=[8, 16, 32])
+                            qd_sweep=[8, 16, 32],
+                            ec_engine=os.environ.get(
+                                "CEPH_TPU_BENCH_EC_ENGINE", ""))
     _emit(stage="cluster",
           write_iops=out["write"].get("iops"),
           write_mbps=out["write"].get("mb_per_sec"),
@@ -533,7 +535,8 @@ def worker_cluster():
           slo=_slo("cluster_write_iops",
                    out["write"].get("iops") or 0.0,
                    p50_ms=out["write"].get("lat_p50_ms"),
-                   p99_ms=out["write"].get("lat_p99_ms")))
+                   p99_ms=out["write"].get("lat_p99_ms"),
+                   engine=out.get("copy", {}).get("engine")))
 
 
 def worker_balancer():

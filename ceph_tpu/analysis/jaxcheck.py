@@ -506,6 +506,48 @@ def _contract_pallas() -> List[Case]:
     return out
 
 
+def _contract_pallas_engine() -> List[Case]:
+    """The registry-promoted 'pallas-fused' engine: a jerasure/isa
+    profile with ``engine=pallas-fused`` must honor the same byte
+    signatures as the engines it replaces, on the single-device path
+    (encode + batched encode) AND the mesh path (per-device fused
+    dispatch).  Concrete mode on tiny shapes: the per-device split is
+    host-side orchestration with no single traceable form."""
+    import numpy as np
+
+    from ..ec.jerasure import make_jerasure
+    from ..parallel.placement import make_mesh
+
+    plugin = make_jerasure({"technique": "reed_sol_van", "k": "4",
+                            "m": "2", "w": "8",
+                            "engine": "pallas-fused"})
+    bc = plugin._code
+    assert bc.force_fused, "profile engine=pallas-fused not routed"
+    k, m, L, B = bc.k, bc.m, 64, 4
+    rng = np.random.default_rng(0xFA)
+    data = rng.integers(0, 256, (k, L), dtype=np.uint8)
+    stripes = rng.integers(0, 256, (B, k, L), dtype=np.uint8)
+    out = [
+        Case("encode", bc.encode, [data], [((m, L), "uint8")],
+             mode="concrete"),
+        Case(f"encode_batched/B={B}",
+             lambda s: bc.encode_batched(s, mesh=None), [stripes],
+             [((B, m, L), "uint8")], mode="concrete"),
+    ]
+    import jax
+
+    devs = jax.devices()
+    meshes = [(1, make_mesh(devs[:1], axis_name="ec"))]
+    if len(devs) > 1:
+        meshes.append((len(devs), make_mesh(devs, axis_name="ec")))
+    for n_dev, mesh in meshes:
+        out.append(Case(
+            f"encode_batched_sharded/B={B}/ndev={n_dev}",
+            lambda s, mesh=mesh: bc.encode_batched_sharded(s, mesh),
+            [stripes], [((B, m, L), "uint8")], mode="concrete"))
+    return out
+
+
 def _contract_crush_mapper() -> List[Case]:
     """crush_do_rule_batched: (arrays, weight u32[D], xs u32[N]) →
     (results i32[N, R], lens i32[N]) for both rule families (firstn
@@ -687,6 +729,7 @@ def _register_builtin_contracts() -> None:
     register_contract("ec.clay", _contract_clay)
     register_contract("ec.native_gf", _contract_native_gf)
     register_contract("ec.pallas", _contract_pallas)
+    register_contract("ec.pallas_engine", _contract_pallas_engine)
     register_contract("crush.mapper_jax", _contract_crush_mapper)
     register_contract("crush.mapper_spec", _contract_crush_mapper_spec)
 

@@ -53,9 +53,14 @@ class Encoder:
         return self
 
     # -- blobs / strings ---------------------------------------------
-    def blob(self, b: bytes) -> "Encoder":
+    def blob(self, b) -> "Encoder":
+        """Accepts any buffer-protocol object and stages it AS IS —
+        the bufferlist::append(raw) role: views stay views until the
+        single gathered join in ``bytes()``, so a WAL record over a
+        pooled recv segment costs one materialisation, not two.  The
+        buffer must stay valid until ``bytes()`` is called."""
         self._parts.append(_U32.pack(len(b)))
-        self._parts.append(bytes(b))
+        self._parts.append(b)
         return self
 
     def str_(self, s: str) -> "Encoder":
@@ -102,6 +107,11 @@ class DecodeError(MalformedInput):
 class Decoder:
     def __init__(self, buf: bytes, pos: int = 0,
                  struct_name: str = "structure"):
+        if isinstance(buf, memoryview):
+            # copy-ok: decode is the cold path (WAL replay, map
+            # install) and every primitive below slices + unpacks —
+            # normalizing once beats a view-aware copy per field
+            buf = bytes(buf)
         self._b = buf
         self._pos = pos
         self._ends: List[int] = []
@@ -210,7 +220,8 @@ def encode_txn(ops: List[Tuple], enc: Encoder) -> None:
                 enc.i64(field)
             elif isinstance(field, (bytes, bytearray, memoryview)):
                 enc.u8(_T_BYTES)
-                enc.blob(bytes(field))
+                enc.blob(field)  # staged as a view; Encoder.bytes()
+                # is the one materialisation
             elif isinstance(field, dict):
                 enc.u8(_T_MAP)
                 enc.str_blob_map(field)

@@ -171,6 +171,18 @@ REGISTRY: Dict[str, Dict[str, str]] = {
         "live_buffer_bytes": GAUGE,
         "live_buffer_bytes_hw": GAUGE,
     },
+    # the pooled buffer plane (common/bufpool.py): recv-segment
+    # recycling rates, live-segment gauges, and the GC-observed leak
+    # count the per-test gate in tests/conftest.py red-checks
+    "obs.bufpool": {
+        "acquires": U64,
+        "releases": U64,
+        "pool_hits": U64,
+        "pool_misses": U64,
+        "leaked_segments": U64,
+        "live_segments": GAUGE,
+        "live_bytes": GAUGE,
+    },
     # the byte-copy ledger (common/copytrack.py): every host-side
     # bytes copy on the hot write path books here, per site plus the
     # cross-site totals the daemonperf cp/op column divides.  Site

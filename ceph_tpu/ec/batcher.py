@@ -61,7 +61,11 @@ class EncodeBatcher:
         """Drop-in for ``code.encode(want, raw)``: queue, then either
         lead a batched dispatch for everyone queued or wait for a
         concurrent leader to cover this request."""
-        req = _EncodeReq(code, set(want_to_encode), bytes(raw))
+        # raw is staged AS IS (bytes, bytearray, or a memoryview into
+        # a pooled recv segment): the caller blocks on req.done until
+        # its group's dispatch completes, so the buffer outlives every
+        # read of it — no defensive copy
+        req = _EncodeReq(code, set(want_to_encode), raw)
         with self._qlock:
             self._q.append(req)
         while not req.done.is_set():
@@ -113,6 +117,8 @@ class EncodeBatcher:
             # shapes come from a bounded set (recompile budget); the
             # pad rows cost arithmetic, not compiles, and are dropped
             pad = (1 << (len(raws) - 1).bit_length()) - len(raws)
+            # copy-ok: zero pad rows are freshly allocated, not copied
+            # from any payload — there is no view to keep
             raws += [bytes(len(raws[0]))] * pad
             outs = code.encode_batched(want, raws, mesh=self._mesh)
             for r, out in zip(part, outs):

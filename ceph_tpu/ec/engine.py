@@ -217,12 +217,23 @@ class BitCode:
 
     ``coding_bm``: (w*m, w*k) 0/1 coding bitmatrix (rows produce the m
     parity chunks' row-blocks from the k data chunks' row-blocks).
+
+    ``force_fused``: route w=8 byte layouts through the Pallas fused
+    unpack→MXU→pack kernel unconditionally — compiled on TPU,
+    interpret mode elsewhere (the registry's 'pallas-fused' engine).
+    Without it the fused kernel still applies opportunistically on a
+    TPU backend.
     """
 
     def __init__(self, k: int, m: int, coding_bm: np.ndarray,
-                 layout: Layout):
+                 layout: Layout, force_fused: bool = False):
         self.k, self.m = k, m
         self.layout = layout
+        self.force_fused = force_fused
+        if force_fused and (layout.is_packet or layout.w != 8):
+            raise ValueError(
+                "pallas-fused engine requires a plain byte (w=8) "
+                "layout")
         w = layout.w
         assert coding_bm.shape == (w * m, w * k), coding_bm.shape
         self.coding_bm = np.asarray(coding_bm, np.uint8) & 1
@@ -236,12 +247,14 @@ class BitCode:
     # -- encode -------------------------------------------------------
     def _fused_w8(self):
         """The Pallas fused path applies on TPU for plain byte (w=8)
-        layouts — the bandwidth-bound RS/isa shape; None otherwise."""
+        layouts — the bandwidth-bound RS/isa shape — or anywhere when
+        ``force_fused`` selected it (interpret mode off-TPU); None
+        otherwise."""
         if self.layout.is_packet or self.layout.w != 8:
             return None
         from . import pallas_kernels as PK
 
-        return PK if PK.on_tpu() else None
+        return PK if (self.force_fused or PK.on_tpu()) else None
 
     def encode(self, data):
         """u8[k, L] -> parity u8[m, L]."""
@@ -251,7 +264,8 @@ class BitCode:
         t0 = time.monotonic()
         pk = self._fused_w8()
         if pk is not None:
-            out = pk.fused_gf2_matmul_w8(self._enc_dev, data)
+            out = pk.fused_gf2_matmul_w8(self._enc_dev, data,
+                                         interpret=not pk.on_tpu())
         else:
             rows = self.layout.to_rows(data)
             out = self.layout.from_rows(
@@ -297,7 +311,8 @@ class BitCode:
         flat = stripes.transpose(1, 0, 2).reshape(self.k, B * L)
         pk = self._fused_w8()
         if pk is not None:
-            out = pk.fused_gf2_matmul_w8(self._enc_dev, flat)
+            out = pk.fused_gf2_matmul_w8(self._enc_dev, flat,
+                                         interpret=not pk.on_tpu())
         else:
             rows = self.layout.to_rows(flat)
             out = self.layout.from_rows(
@@ -365,14 +380,38 @@ class BitCode:
             stripes = jnp.concatenate(
                 [stripes, jnp.zeros((Bp - B, k, L), jnp.uint8)],
                 axis=0)
-        sharded = jax.device_put(
-            stripes, NamedSharding(mesh, P(axis_name, None, None)))
-        out = self._mesh_fn(mesh, axis_name)(sharded)
+        pk = self._fused_w8()
+        if pk is not None:
+            # fused mesh path: split the padded batch evenly, flatten
+            # each shard along the byte axis ((b, k, L) -> (k, b*L) —
+            # GF(2) matmul columns are independent), and run the SAME
+            # fused kernel committed to each chip.  Byte-identical to
+            # the vmapped path: identical arithmetic over disjoint
+            # columns.
+            devs = list(np.asarray(mesh.devices).ravel())  # jax-ok: mesh.devices is a host-side numpy array of Device handles
+            per = Bp // n_dev
+            interp = not pk.on_tpu()
+            parts = []
+            for d, grp in zip(devs, jnp.split(stripes, n_dev)):
+                flat = jax.device_put(
+                    grp.transpose(1, 0, 2).reshape(k, per * L), d)
+                par = pk.fused_gf2_matmul_w8(self._enc_dev, flat,
+                                             interpret=interp)
+                parts.append(np.asarray(par).reshape(  # jax-ok: per-device gather — parts are committed to distinct chips and must meet on host
+                    self.m, per, L).transpose(1, 0, 2))
+            # per-device results are committed to distinct chips;
+            # gather on host (the callers materialize anyway)
+            out = np.concatenate(parts, axis=0)
+        else:
+            sharded = jax.device_put(
+                stripes, NamedSharding(mesh, P(axis_name, None, None)))
+            out = self._mesh_fn(mesh, axis_name)(sharded)
         if Bp != B:
             out = out[:B]
         _account("encode",
                  ("encb_mesh", self.coding_bm.shape, (Bp, k, L),
-                  self.layout.w, self.layout.packetsize, n_dev),
+                  self.layout.w, self.layout.packetsize, n_dev,
+                  pk is not None),
                  time.monotonic() - t0, B * k * L,
                  nbytes_out=B * self.m * L,
                  device_ids=[int(d.id) for d in
@@ -414,7 +453,8 @@ class BitCode:
         t0 = time.monotonic()
         pk = self._fused_w8()
         if pk is not None:
-            out = pk.fused_gf2_matmul_w8(inv, stack)
+            out = pk.fused_gf2_matmul_w8(inv, stack,
+                                         interpret=not pk.on_tpu())
         else:
             rows = self.layout.to_rows(stack)
             out = self.layout.from_rows(_mod2_matmul(inv, rows),

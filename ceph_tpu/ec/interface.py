@@ -136,8 +136,11 @@ class ErasureCode:
     def encode_prepare(self, raw: bytes | np.ndarray) -> np.ndarray:
         """Split + zero-pad into k aligned data chunks
         (ErasureCode.cc:150-185).  Returns u8[k, chunk_size]."""
-        raw = np.frombuffer(raw, np.uint8) if isinstance(raw, (bytes,
-                                                               bytearray)) \
+        # buffer-protocol inputs (bytes, bytearray, a memoryview into
+        # a pooled recv segment) wrap zero-copy via np.frombuffer —
+        # the one host materialisation is the padded chunk array below
+        raw = np.frombuffer(raw, np.uint8) if isinstance(
+            raw, (bytes, bytearray, memoryview)) \
             else np.asarray(raw, np.uint8).ravel()
         k = self.get_data_chunk_count()
         blocksize = self.get_chunk_size(len(raw))

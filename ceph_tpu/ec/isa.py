@@ -35,6 +35,7 @@ class ErasureCodeIsa(ErasureCode):
         self.technique = technique
         self.k = 0
         self.m = 0
+        self.engine = ""
         self._code: BitCode | None = None
 
     def init(self, profile: ErasureCodeProfile) -> None:
@@ -47,6 +48,15 @@ class ErasureCodeIsa(ErasureCode):
         self.k = self.to_int("k", profile, DEFAULT_K)
         self.m = self.to_int("m", profile, DEFAULT_M)
         self.sanity_check_k_m(self.k, self.m)
+        # per-pool engine selection (isa is always a w=8 byte layout,
+        # so every engine applies); wins over CEPH_TPU_EC_ENGINE
+        from .native_gf import ENGINES
+
+        self.engine = profile.get("engine", "")
+        if self.engine and self.engine not in ENGINES:
+            raise ErasureCodeError(
+                -22, f"engine={self.engine} must be one of "
+                     f"{list(ENGINES)}")
         if self.technique == "reed_sol_van":
             # isa-l's Vandermonde construction is not MDS everywhere;
             # clamp to the verified-safe region (ErasureCodeIsa.cc:331)
@@ -68,14 +78,16 @@ class ErasureCodeIsa(ErasureCode):
         coding = full[self.k:]
         from .native_gf import NativeMatrixCode, engine_choice
 
-        if engine_choice() == "native":
+        choice = engine_choice(self.engine)
+        if choice == "native":
             # the ec_encode_data role on its native engine (isa-l is
             # GF(2^8) table asm; this is the same math via the C++
             # OpenMP kernel) — same bytes as the bit-plane engine
             self._code = NativeMatrixCode(self.k, self.m, coding)
             return
         cb = GFW(8).expand_bitmatrix(coding)
-        self._code = BitCode(self.k, self.m, cb, Layout(8))
+        self._code = BitCode(self.k, self.m, cb, Layout(8),
+                             force_fused=choice == "pallas-fused")
 
     # -- geometry (ErasureCodeIsa.cc:66-79) ---------------------------
     def get_chunk_count(self) -> int:

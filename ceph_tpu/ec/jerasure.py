@@ -50,6 +50,7 @@ class ErasureCodeJerasure(ErasureCode):
         self.k = 0
         self.m = 0
         self.w = 0
+        self.engine = ""
         self.per_chunk_alignment = False
         self._code: BitCode | None = None
 
@@ -64,6 +65,16 @@ class ErasureCodeJerasure(ErasureCode):
         self.k = self.to_int("k", profile, DEFAULT_K)
         self.m = self.to_int("m", profile, DEFAULT_M)
         self.w = self.to_int("w", profile, self.default_w())
+        # profile engine= selects the execution engine per pool
+        # (native GF(2^8) table / bitplane XLA / pallas-fused kernel);
+        # wins over the CEPH_TPU_EC_ENGINE process override
+        from .native_gf import ENGINES
+
+        self.engine = profile.get("engine", "")
+        if self.engine and self.engine not in ENGINES:
+            raise ErasureCodeError(
+                -22, f"engine={self.engine} must be one of "
+                     f"{list(ENGINES)}")
         self._parse_mapping(profile)
         if self.chunk_mapping and \
                 len(self.chunk_mapping) != self.k + self.m:
@@ -140,18 +151,25 @@ class _MatrixTechnique(ErasureCodeJerasure):
         return alignment
 
     def _make_code(self, coding_rows) -> None:
-        if self.w == 8:
+        from .native_gf import NativeMatrixCode, engine_choice
+
+        if self.w != 8:
+            if self.engine in ("native", "pallas-fused"):
+                raise ErasureCodeError(
+                    -22, f"engine={self.engine} requires w=8 "
+                         f"(byte layout), have w={self.w}")
+            choice = "bitplane"
+        else:
+            choice = engine_choice(self.engine)
+        if choice == "native":
             # w=8 RS rides the native GF(2^8) table engine (the isa-l
             # role) when present — same generator matrix, same bytes,
             # 7-40x the portable bit-plane engine on CPU
-            from .native_gf import NativeMatrixCode, engine_choice
-
-            if engine_choice() == "native":
-                self._code = NativeMatrixCode(self.k, self.m,
-                                              coding_rows)
-                return
+            self._code = NativeMatrixCode(self.k, self.m, coding_rows)
+            return
         cb = GFW(self.w).expand_bitmatrix(coding_rows)
-        self._code = BitCode(self.k, self.m, cb, Layout(self.w))
+        self._code = BitCode(self.k, self.m, cb, Layout(self.w),
+                             force_fused=choice == "pallas-fused")
 
 
 class ReedSolomonVandermonde(_MatrixTechnique):
@@ -199,6 +217,10 @@ class _PacketTechnique(ErasureCodeJerasure):
         super().parse(profile)
         self.packetsize = self.to_int("packetsize", profile,
                                       DEFAULT_PACKETSIZE)
+        if self.engine and self.engine != "bitplane":
+            raise ErasureCodeError(
+                -22, f"engine={self.engine}: packet/bitmatrix "
+                     f"techniques run only on the bit-plane engine")
 
     def get_alignment(self) -> int:
         """Cauchy/liberation alignment (ErasureCodeJerasure.cc:278-292)."""

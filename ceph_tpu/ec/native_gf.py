@@ -65,24 +65,37 @@ def gf8_matmul(mat: np.ndarray, data: np.ndarray) -> np.ndarray:
     return out
 
 
-def engine_choice() -> str:
+ENGINES = ("native", "bitplane", "pallas-fused")
+
+
+def engine_choice(profile_engine: str = "") -> str:
     """Which engine the plugin registry should put behind w=8 MATRIX
     techniques: 'native' (the GF(2^8) table engine — the isa-l role,
-    7-40x the portable bit-plane engine on CPU) unless overridden via
-    CEPH_TPU_EC_ENGINE=bitplane or the native library is unavailable.
-    Mirrors the reference's plugin-selection rationale
-    (src/erasure-code/isa/ErasureCodeIsa.cc:333-336: pick the fastest
-    verified engine for the shape)."""
+    7-40x the portable bit-plane engine on CPU) unless overridden or
+    the native library is unavailable.  Mirrors the reference's
+    plugin-selection rationale (src/erasure-code/isa/
+    ErasureCodeIsa.cc:333-336: pick the fastest verified engine for
+    the shape).
+
+    ``profile_engine`` is the pool profile's ``engine=`` key and wins
+    over the process-wide CEPH_TPU_EC_ENGINE env override.  Choices:
+    'native', 'bitplane' (the array/XLA engine), and 'pallas-fused'
+    (the fused unpack→MXU→pack kernel — compiled on TPU, interpret
+    mode on CPU; byte-identical to bitplane by the corpus tests)."""
     import os
 
-    forced = os.environ.get("CEPH_TPU_EC_ENGINE", "")
-    if forced == "bitplane":
-        return "bitplane"
+    forced = profile_engine or os.environ.get("CEPH_TPU_EC_ENGINE", "")
+    if forced and forced not in ENGINES:
+        raise RuntimeError(
+            f"unknown EC engine {forced!r}; have {list(ENGINES)}")
+    if forced in ("bitplane", "pallas-fused"):
+        return forced
     if forced == "native":
         if not available():
             raise RuntimeError(
-                "CEPH_TPU_EC_ENGINE=native but the native GF engine "
-                "failed to build/load — unset it or fix the toolchain")
+                "EC engine 'native' requested but the native GF "
+                "engine failed to build/load — unset it or fix the "
+                "toolchain")
         return "native"
     return "native" if available() else "bitplane"
 

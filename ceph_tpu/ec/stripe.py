@@ -229,12 +229,13 @@ def crc32c(data: bytes | np.ndarray, crc: int = 0xFFFFFFFF) -> int:
     fn = _native_crc32c()
     if fn is not None:
         buf = np.frombuffer(data, np.uint8) if isinstance(
-            data, (bytes, bytearray)) \
+            data, (bytes, bytearray, memoryview)) \
             else np.ascontiguousarray(np.asarray(data, np.uint8).ravel())
         return int(fn(crc & 0xFFFFFFFF, buf, len(buf)))
     t = _crc_setup()
     buf = np.frombuffer(data, np.uint8) if isinstance(
-        data, (bytes, bytearray)) else np.asarray(data, np.uint8).ravel()
+        data, (bytes, bytearray, memoryview)) else \
+        np.asarray(data, np.uint8).ravel()
     s = int(crc) & 0xFFFFFFFF
     nb = len(buf) // _CRC_BLOCK
     if nb:

@@ -60,6 +60,7 @@ from typing import Callable, Dict, Optional, Tuple
 from ..analysis import faults
 from ..analysis import watchdog
 from ..analysis.lockdep import make_lock, make_rlock
+from ..common import bufpool
 from ..common import copytrack
 from ..common.backoff import Backoff
 from ..common.encoding import MalformedInput
@@ -159,9 +160,14 @@ def _lift_blobs(obj, blobs: list):
     the front/data split of the reference's Message bufferlists.  A
     LITERAL single-key dict that collides with either wire sentinel is
     escaped so _restore_blobs hands it back verbatim instead of
-    resolving it into an unrelated data segment."""
+    resolving it into an unrelated data segment.
+
+    Blobs are kept as the caller's buffer-protocol object (bytes,
+    bytearray, memoryview) — NOT copied: the frame is materialised in
+    exactly one gathered join at send time (`_send_frame`), and the
+    caller's buffer is only read while it blocks in the send."""
     if isinstance(obj, (bytes, bytearray, memoryview)):
-        blobs.append(bytes(obj))
+        blobs.append(obj)
         return {_BLOB_KEY: len(blobs) - 1}
     if isinstance(obj, dict):
         if len(obj) == 1 and next(iter(obj)) in (_BLOB_KEY, _ESC_KEY):
@@ -194,10 +200,31 @@ def _restore_blobs(obj, blobs: list):
     return obj
 
 
-def encode_frame(msg: Dict, keyring=None) -> bytes:
-    """The pure frame codec, encode half (the wirecheck-registered
-    seam): header + JSON control segment + blob table.  The outer
-    length word is the transport's, added at send time."""
+def _materialize_views(obj, pc=None, site: str = "recv"):
+    """Deep-copy every memoryview leaf to bytes — the DELIBERATE copy
+    for data that outlives its pooled recv segment (a reply payload
+    handed to a waiting caller, a cached reply that a retransmission
+    may resend seconds later).  Booked per leaf at the given ledger
+    site; anything without views passes through untouched."""
+    if isinstance(obj, memoryview):
+        b = bytes(obj)  # copy-ok: stabilizing a view past its segment
+        if pc is not None:
+            copytrack.book_pc(pc, site, len(b), copies=1)
+        return b
+    if isinstance(obj, dict):
+        return {k: _materialize_views(v, pc, site)
+                for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_materialize_views(v, pc, site) for v in obj]
+    return obj
+
+
+def encode_frame_parts(msg: Dict, keyring=None):
+    """The pure frame codec, encode half, as a GATHER LIST: header +
+    JSON control segment + blob table, with every data segment still
+    the caller's buffer (no per-blob copy).  Returns (parts, nbytes);
+    the transport joins the list exactly once at send time — the one
+    deliberate, booked send-side materialisation."""
     blobs: list = []
     jmsg = _lift_blobs(msg, blobs)
     if keyring is not None:
@@ -210,21 +237,35 @@ def encode_frame(msg: Dict, keyring=None) -> bytes:
         flags |= _FL_ZLIB
     parts = [struct.pack("<BBI", _FRAME_V, flags, len(body)), body,
              struct.pack("<I", len(blobs))]
+    nbytes = 10 + len(body)
     for b in blobs:
         parts.append(struct.pack("<I", len(b)))
         parts.append(b)
+        nbytes += 4 + len(b)
+    return parts, nbytes
+
+
+def encode_frame(msg: Dict, keyring=None) -> bytes:
+    """The pure frame codec, encode half (the wirecheck-registered
+    seam): header + JSON control segment + blob table.  The outer
+    length word is the transport's, added at send time."""
+    parts, _n = encode_frame_parts(msg, keyring)
     return b"".join(parts)
 
 
-def decode_frame(payload: bytes) -> Tuple[Dict, list]:
+def decode_frame(payload) -> Tuple[Dict, list]:
     """The pure frame codec, decode half.  Returns (msg, blobs);
     ``msg`` still holds data-segment references (the dispatcher
-    restores them after MAC verification).  Every length field is
-    bounds-checked against the frame, every parse failure raises
-    MalformedInput: a truncated, forged, or compression-bomb frame
-    must be a clean protocol error, never an uncaught struct.error
-    (or an unbounded allocation) that kills the reader thread with
-    its cleanup skipped."""
+    restores them after MAC verification).  ``payload`` may be bytes
+    or a memoryview over a pooled recv segment — data segments come
+    back as ZERO-COPY slices of it (views are only valid while the
+    segment is held; anything outliving the frame copies deliberately
+    via ``_materialize_views``).  Every length field is bounds-checked
+    against the frame, every parse failure raises MalformedInput: a
+    truncated, forged, or compression-bomb frame must be a clean
+    protocol error, never an uncaught struct.error (or an unbounded
+    allocation) that kills the reader thread with its cleanup
+    skipped."""
     if len(payload) < 6:
         raise MalformedInput(
             f"frame too short ({len(payload)} bytes)")
@@ -264,6 +305,10 @@ def decode_frame(payload: bytes) -> Tuple[Dict, list]:
             raise MalformedInput("truncated blob")
         blobs.append(payload[pos:pos + blen])
         pos += blen
+    if isinstance(body, memoryview):
+        # copy-ok: control segment only — json needs a bytes object;
+        # the data segments above stay views of the pooled payload
+        body = bytes(body)
     try:
         msg = json.loads(body.decode())  # wire-ok: the frame codec seam
     except (UnicodeDecodeError, json.JSONDecodeError) as e:
@@ -274,39 +319,72 @@ def decode_frame(payload: bytes) -> Tuple[Dict, list]:
     return msg, blobs
 
 
+_HAS_SENDMSG = hasattr(socket.socket, "sendmsg")
+
+
+def _sendmsg_all(sock: socket.socket, parts: list) -> None:
+    """Scatter-gather send of the whole parts list (the writev role)
+    with partial-send continuation — the data segments go from the
+    caller's buffers straight to the kernel, never joined in
+    userspace."""
+    views = [memoryview(p) for p in parts]
+    while views:
+        n = sock.sendmsg(views)
+        while views and n >= len(views[0]):
+            n -= len(views[0])
+            views.pop(0)
+        if views and n:
+            views[0] = views[0][n:]
+
+
 def _send_frame(sock: socket.socket, msg: Dict, keyring=None,
-                mutate=None) -> int:
+                mutate=None) -> Tuple[int, int]:
     """Queue the frame on the socket's writer and flush — coalescing
     with whatever else is queued — as the writer-lock holder.  Returns
-    the wire size (header + payload) for the byte counters; raises the
-    send failure on the CALLER's thread even when another thread's
-    flush carried (and failed) this frame.
+    ``(wire_size, joined)``: the wire size (header + payload) for the
+    byte counters, and how many bytes were actually materialised in a
+    userspace join (0 on the gathered fast path — the caller books
+    that at the "send" ledger site).  Raises the send failure on the
+    CALLER's thread even when another thread's flush carried (and
+    failed) this frame.
 
     ``mutate`` (fault injection only) post-processes the framed bytes
     — flipping or truncating them — INSIDE the writer path, so the
     damaged frame still serializes correctly against coalesced
     writers instead of interleaving mid-batch."""
-    payload = encode_frame(msg, keyring)
-    buf = struct.pack(">I", len(payload)) + payload
+    parts, plen = encode_frame_parts(msg, keyring)
+    parts.insert(0, struct.pack(">I", plen))
+    buf = None
     if mutate is not None:
-        buf = mutate(buf)
+        # fault injection needs the contiguous frame to damage it
+        buf = mutate(b"".join(parts))
+    elif not _HAS_SENDMSG:
+        buf = b"".join(parts)
     w = _writer_for(sock)
-    # uncontended fast path: writer idle, nothing queued — send
-    # directly with no completion bookkeeping (the common case; the
-    # coalescing machinery below only engages under write contention)
+    # uncontended fast path: writer idle, nothing queued — gathered
+    # sendmsg straight from the caller's buffers, no join at all (the
+    # common case; the coalescing machinery below only engages under
+    # write contention)
     if not w.q and w.lock.acquire(blocking=False):
         fast = False
         try:
             if not w.q:
                 fast = True
-                sock.sendall(buf)
+                if buf is not None:
+                    sock.sendall(buf)
+                else:
+                    _sendmsg_all(sock, parts)
         except OSError:
             _reap_writer(sock)
             raise
         finally:
             w.lock.release()
         if fast:
-            return len(payload) + 4
+            return plen + 4, len(buf) if buf is not None else 0
+    # contended path: the frame joins once so the flush-holder can
+    # batch it with its queue neighbours in one send
+    if buf is None:
+        buf = b"".join(parts)
     op = _SendOp(buf)
     w.q.append(op)  # deque.append is atomic; order = send order
     while not op.done.is_set():
@@ -338,7 +416,7 @@ def _send_frame(sock: socket.socket, msg: Dict, keyring=None,
     if op.error is not None:
         _reap_writer(sock)  # dead socket: never strand its entry
         raise op.error
-    return len(payload) + 4
+    return plen + 4, len(buf)
 
 
 def _flip_control_byte(buf: bytes) -> bytes:
@@ -355,7 +433,7 @@ def _flip_control_byte(buf: bytes) -> bytes:
         return buf
     out = bytearray(buf)
     out[pos] ^= 0xFF
-    return bytes(out)
+    return out  # bytearray: sendall/join take it without another copy
 
 
 def _truncate_frame(buf: bytes) -> bytes:
@@ -365,33 +443,51 @@ def _truncate_frame(buf: bytes) -> bytes:
     return buf[:max(4, len(buf) // 2)]
 
 
-def _recv_exact(sock: socket.socket, n: int):
-    """Preallocated recv_into: a 64 KiB data frame arrives in a few
-    segments, and the old ``buf += got`` concat re-copied the prefix
-    on every one."""
-    buf = bytearray(n)
-    view = memoryview(buf)
+def _recv_into(sock: socket.socket, view: memoryview) -> bool:
+    """Fill ``view`` from the socket; False on EOF.  recv_into a
+    caller-owned view: a 64 KiB data frame arrives in a few segments
+    and neither concatenates prefixes nor allocates per segment."""
     pos = 0
+    n = len(view)
     while pos < n:
         got = sock.recv_into(view[pos:])
         if not got:
-            return None
+            return False
         pos += got
+    return True
+
+
+def _recv_exact(sock: socket.socket, n: int):
+    """Preallocated recv_into (header words and tests)."""
+    buf = bytearray(n)
+    if not _recv_into(sock, memoryview(buf)):
+        return None
     return buf
 
 
 def _recv_frame(sock: socket.socket):
-    """Returns (msg, blobs, nbytes) or None on EOF; parse errors
-    surface as MalformedInput from the codec and drop the session."""
+    """Returns (msg, blobs, nbytes, seg) or None on EOF; parse errors
+    surface as MalformedInput from the codec and drop the session.
+
+    The payload lands in a pooled segment (``seg``) via recv_into —
+    the ONE recv-side materialisation of the frame — and ``blobs`` are
+    zero-copy views into it.  Ownership of the segment (refcount 1)
+    passes to the caller on success; EOF and parse errors release it
+    here."""
     header = _recv_exact(sock, 4)
     if header is None:
         return None
     (length,) = struct.unpack(">I", header)
-    payload = _recv_exact(sock, length)
-    if payload is None:
-        return None
-    msg, blobs = decode_frame(payload)
-    return msg, blobs, length
+    seg = bufpool.acquire(length, tag="msgr.recv")
+    try:
+        if not _recv_into(sock, seg.writable()):
+            seg.release()
+            return None
+        msg, blobs = decode_frame(seg.view())
+    except BaseException:
+        seg.release()
+        raise
+    return msg, blobs, length, seg
 
 
 class _OutSession:
@@ -614,18 +710,18 @@ class Messenger:
                     break  # closed or corrupt frame: drop the session
                 if got is None:
                     break
-                msg, blobs, nbytes = got
+                msg, blobs, nbytes, seg = got
                 self.pc.inc("bytes_in", nbytes + 4)
                 self.pc.inc("frames_in")
-                # recv copies: the preallocated payload bytearray is
-                # one full-frame copy, and each data-segment slice in
-                # decode_frame materialises its blob once more
-                copytrack.book_pc(
-                    self._copy_pc, "recv",
-                    nbytes + sum(len(b) for b in blobs),
-                    copies=1 + len(blobs))
+                # recv copies: ONE recv_into fill of the pooled
+                # segment per frame — the data-segment slices are
+                # views into it now, so the old per-blob
+                # re-materialisation is gone; anything outliving the
+                # frame books its own copy via _materialize_views
+                copytrack.book_pc(self._copy_pc, "recv", nbytes,
+                                  copies=1)
                 try:
-                    self._dispatch(conn, msg, blobs, nbytes)
+                    self._dispatch(conn, msg, blobs, nbytes, seg)
                 except Exception as e:
                     # a poisoned frame (bad blob reference, malformed
                     # control fields) drops THAT frame; the reader —
@@ -716,15 +812,17 @@ class Messenger:
             elif faults.fires("msgr.close_mid_frame", self.name):
                 mutate = _truncate_frame
                 close_after = True
-        n = _send_frame(conn, msg, self.keyring, mutate=mutate)
+        n, joined = _send_frame(conn, msg, self.keyring,
+                                mutate=mutate)
         self.pc.inc("bytes_out", n)
         self.pc.inc("frames_out")
-        # send copies: encode_frame's b"".join materialises the whole
-        # payload once, and the length-word concat in _send_frame
-        # copies it again — ~2x the wire size per frame, the number
-        # a zero-copy framing refactor must drive down
-        copytrack.book_pc(self._copy_pc, "send", 2 * (n - 4),
-                          copies=2)
+        # send copies: the uncontended path gathers the frame straight
+        # from the caller's buffers (sendmsg scatter-gather — zero
+        # userspace join); only the contended/fault paths materialise
+        # the frame, and exactly that join is booked
+        if joined:
+            copytrack.book_pc(self._copy_pc, "send", joined,
+                              copies=1)
         if faults._ACTIVE and not close_after and \
                 faults.fires("msgr.dup_frame", self.name):
             # receiver-side seq dedup (or reply-tid idempotence) must
@@ -734,80 +832,120 @@ class Messenger:
             self._hard_close(conn)
 
     def _dispatch(self, conn: socket.socket, msg: Dict, blobs: list,
-                  nbytes: int) -> None:
-        t_rx = time.monotonic()  # dispatch_lat anchor: frame receipt
-        if self.keyring is not None and \
-                not self.keyring.verify(msg, blobs):
-            return  # unauthenticated frame: drop silently (cephx deny)
-        msg = _restore_blobs(msg, blobs)
-        type_ = msg.get("type", "")
-        if type_ == "__reply__":
-            with self._pending_lock:
-                ev = self._waiters.get(msg["tid"])  # drop stragglers
-                if ev is not None:
-                    self._pending[msg["tid"]] = msg.get("payload", {})
-                    ev.set()
-            return
-        if type_ == "__ack__":
-            sess = self._out.get(tuple(msg["addr"]))
-            if sess is not None and msg.get("sess") == self.session_id:
-                sess.trim(int(msg["in_seq"]))  # buf_lock only: an ack
-                # must never wait behind a handshake on this session
-            return
-        if type_ == "__hello__":
-            key = (msg.get("frm", ""), msg.get("sess", ""))
-            with self._in_lock:
-                ins = self._in.setdefault(key, _InSession())
-            self._reply(conn, msg,
-                        {"in_seq": ins.in_seq, "ok": True})
-            return
-
-        seq = msg.get("_s")
-        ins = None
-        if seq is not None:
-            key = (msg.get("frm", ""), msg.get("_sess", ""))
-            with self._in_lock:
-                ins = self._in.setdefault(key, _InSession())
-                dup = seq <= ins.in_seq
-                if not dup:
-                    ins.in_seq = seq
-            if dup:
-                # duplicate (retransmission or replayed capture):
-                # never re-execute; resend the original reply.  If the
-                # original is still being handled on another thread,
-                # wait briefly for its reply to land in the cache.
-                if msg.get("tid") is not None:
-                    self._pool_submit(self._resend_cached, conn, ins,
-                                      seq)
+                  nbytes: int, seg=None) -> None:
+        """Owns ``seg`` — the pooled recv segment every blob view in
+        this frame lives in.  ``owned`` tracks the obligation: early
+        control paths fall through to the release in ``finally``; the
+        handler paths transfer ownership (the fifo entry / the pool
+        task releases after the handler returns — views in ``msg``
+        are valid exactly that long).  A parse or verify failure
+        releases before the error reaches the reader's
+        drop-bad-frame log."""
+        owned = seg
+        try:
+            t_rx = time.monotonic()  # dispatch_lat anchor: receipt
+            if self.keyring is not None and \
+                    not self.keyring.verify(msg, blobs):
+                return  # unauthenticated frame: drop (cephx deny)
+            msg = _restore_blobs(msg, blobs)
+            type_ = msg.get("type", "")
+            if type_ == "__reply__":
+                # the waiting caller keeps the payload past this
+                # frame: stabilize its views NOW (the one deliberate
+                # recv-side copy a read reply pays), then the
+                # segment can recycle
+                payload = _materialize_views(msg.get("payload", {}),
+                                             self._copy_pc, "recv")
+                with self._pending_lock:
+                    ev = self._waiters.get(msg["tid"])  # drop
+                    # stragglers
+                    if ev is not None:
+                        self._pending[msg["tid"]] = payload
+                        ev.set()
+                return
+            if type_ == "__ack__":
+                sess = self._out.get(tuple(msg["addr"]))
+                if sess is not None and \
+                        msg.get("sess") == self.session_id:
+                    sess.trim(int(msg["in_seq"]))  # buf_lock only:
+                    # an ack must never wait behind a handshake on
+                    # this session
+                return
+            if type_ == "__hello__":
+                key = (msg.get("frm", ""), msg.get("sess", ""))
+                with self._in_lock:
+                    ins = self._in.setdefault(key, _InSession())
+                self._reply(conn, msg,
+                            {"in_seq": ins.in_seq, "ok": True})
                 return
 
-        # handler execution moves OFF the reader thread (the
-        # reference's DispatchQueue + fast-dispatch workers,
-        # src/msg/DispatchQueue.h): one connection can have many ops
-        # in flight — without this, a primary fanning a write out to
-        # replicas serializes every other op sharing the connection
-        # behind the fan-out's round trips.  Sequenced frames of
-        # ORDERED types additionally keep per-session FIFO through a
-        # serial lane feeding the pool (below): the quorum layer
-        # relies on mon_commit(v) finishing before mon_accept(v+1)
-        # starts, and two pool workers racing frames from one peer
-        # broke that (spurious non-contiguous nacks → leader
-        # abdication churn).  Everything else stays fully parallel;
-        # per-object order there is owned by PG locks + versions, as
-        # in the reference's sharded op queues.
-        control = type_ in self._control
-        if ins is not None and type_ in self._ordered:
-            with self._in_lock:
-                ins.fifo.append((conn, msg, seq, nbytes, t_rx))
-                drain = not ins.draining
-                if drain:
-                    ins.draining = True
-            if drain:
-                self._pool_submit(self._drain_session, ins,
-                                  control=control)
-        else:
-            self._pool_submit(self._handle, conn, msg, ins, seq,
-                              nbytes, t_rx, control=control)
+            seq = msg.get("_s")
+            ins = None
+            if seq is not None:
+                key = (msg.get("frm", ""), msg.get("_sess", ""))
+                with self._in_lock:
+                    ins = self._in.setdefault(key, _InSession())
+                    dup = seq <= ins.in_seq
+                    if not dup:
+                        ins.in_seq = seq
+                if dup:
+                    # duplicate (retransmission or replayed capture):
+                    # never re-execute; resend the original reply.
+                    # If the original is still being handled on
+                    # another thread, wait briefly for its reply to
+                    # land in the cache.
+                    if msg.get("tid") is not None:
+                        self._pool_submit(self._resend_cached, conn,
+                                          ins, seq)
+                    return
+
+            # handler execution moves OFF the reader thread (the
+            # reference's DispatchQueue + fast-dispatch workers,
+            # src/msg/DispatchQueue.h): one connection can have many
+            # ops in flight — without this, a primary fanning a write
+            # out to replicas serializes every other op sharing the
+            # connection behind the fan-out's round trips.  Sequenced
+            # frames of ORDERED types additionally keep per-session
+            # FIFO through a serial lane feeding the pool (below):
+            # the quorum layer relies on mon_commit(v) finishing
+            # before mon_accept(v+1) starts, and two pool workers
+            # racing frames from one peer broke that (spurious
+            # non-contiguous nacks → leader abdication churn).
+            # Everything else stays fully parallel; per-object order
+            # there is owned by PG locks + versions, as in the
+            # reference's sharded op queues.
+            control = type_ in self._control
+            if ins is not None and type_ in self._ordered:
+                with self._in_lock:
+                    ins.fifo.append((conn, msg, seq, nbytes, t_rx,
+                                     seg))
+                    owned = None  # the fifo entry holds it now
+                    drain = not ins.draining
+                    if drain:
+                        ins.draining = True
+                if drain and not self._pool_submit(
+                        self._drain_session, ins, control=control):
+                    self._flush_fifo(ins)  # shutdown: nothing will
+                    # drain the lane — release its queued segments
+            else:
+                if self._pool_submit(self._handle, conn, msg, ins,
+                                     seq, nbytes, t_rx, seg,
+                                     control=control):
+                    owned = None  # the pool task releases it
+        finally:
+            if owned is not None:
+                owned.release()
+
+    def _flush_fifo(self, ins: _InSession) -> None:
+        """Drop a session's queued frames (pool refused the lane
+        worker at shutdown), releasing their pooled segments."""
+        with self._in_lock:
+            entries = list(ins.fifo)
+            ins.fifo.clear()
+            ins.draining = False
+        for *_rest, seg in entries:
+            if seg is not None:
+                seg.release()
 
     def _drain_session(self, ins: _InSession) -> None:
         """Serial lane worker: run one session's queued frames in
@@ -819,9 +957,9 @@ class Messenger:
                 if not ins.fifo:
                     ins.draining = False
                     return
-                conn, msg, seq, nbytes, t_rx = ins.fifo.popleft()
+                conn, msg, seq, nbytes, t_rx, seg = ins.fifo.popleft()
             try:
-                self._handle(conn, msg, ins, seq, nbytes, t_rx)
+                self._handle(conn, msg, ins, seq, nbytes, t_rx, seg)
             except Exception as e:
                 # the lane must survive a poisoned op, or every later
                 # frame from this session queues forever
@@ -842,7 +980,7 @@ class Messenger:
             time.sleep(0.02)  # fault-ok: bounded 2s poll of the
             # local duplicate-reply cache, not peer retry pacing
 
-    def _pool_submit(self, fn, *args, control: bool = False) -> None:
+    def _pool_submit(self, fn, *args, control: bool = False) -> bool:
         with self._pool_lock:
             if control:
                 pool = self._ctl_pool
@@ -862,12 +1000,26 @@ class Messenger:
                         thread_name_prefix=f"msgr-dispatch:{self.name}")
         try:
             pool.submit(fn, *args)
+            return True
         except RuntimeError:
-            pass  # shutting down
+            return False  # shutting down
 
     def _handle(self, conn: socket.socket, msg: Dict,
                 ins: Optional[_InSession], seq, nbytes: int,
-                t_rx: Optional[float] = None) -> None:
+                t_rx: Optional[float] = None, seg=None) -> None:
+        """``seg`` (when set) is the pooled segment the frame's blob
+        views live in — held for the handler's whole execution (a
+        handler forwarding a view in a fan-out call blocks until the
+        peers reply, so the view stays valid), released on exit."""
+        try:
+            self._handle_inner(conn, msg, ins, seq, nbytes, t_rx)
+        finally:
+            if seg is not None:
+                seg.release()
+
+    def _handle_inner(self, conn: socket.socket, msg: Dict,
+                      ins: Optional[_InSession], seq, nbytes: int,
+                      t_rx: Optional[float] = None) -> None:
         type_ = msg.get("type", "")
         throttle = self.throttles.get(type_)
         if throttle is not None:
@@ -926,6 +1078,12 @@ class Messenger:
                 pass
         if ins is not None:
             if frame is not None:
+                # the cache outlives this frame's pooled segment: a
+                # reply whose payload references request views must
+                # stabilize them before a retransmission seconds
+                # from now resends it (booked deliberate copy)
+                frame = _materialize_views(frame, self._copy_pc,
+                                           "send")
                 with self._in_lock:
                     ins.cache_reply(seq, frame)
             else:
@@ -1097,9 +1255,19 @@ class Messenger:
         try:
             sess.out_seq += 1
             seq = sess.out_seq
+            needs_reply = msg.get("tid") is not None
             frame = dict(msg, _s=seq, _sess=self.session_id,
                          frm=self.name)
-            sess.buffer(seq, frame, msg.get("tid") is not None)
+            if not needs_reply:
+                # a fire-and-forget frame sits in the unacked buffer
+                # past the caller's return, and a reconnect replays
+                # it — any view it carries must be stabilized before
+                # the caller's segment recycles (booked deliberate
+                # copy).  Call frames skip this: the caller blocks
+                # until the seq completes, keeping its views valid.
+                frame = _materialize_views(frame, self._copy_pc,
+                                           "send")
+            sess.buffer(seq, frame, needs_reply)
             try:
                 if sess.synced:
                     self._send(self._connect(addr), frame)

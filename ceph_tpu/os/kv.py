@@ -25,6 +25,8 @@ class KVTransaction:
 
     def set(self, prefix: str, key: str,
             value: bytes) -> "KVTransaction":
+        # copy-ok: KV values are small metadata records the store
+        # retains by reference past the caller's buffer lifetime
         self.ops.append(("set", prefix, key, bytes(value)))
         return self
 

@@ -207,10 +207,13 @@ class MemStore(ObjectStore):
             o = self._coll.get(cid, {}).get(oid)
             if o is None:
                 raise KeyError(f"no object {cid}/{oid}")
+            # the returned payload must stay valid after the lock
+            # drops and later writes mutate o.data, so it cannot be a
+            # view into the object
             if length < 0:
-                out = bytes(o.data[offset:])
+                out = bytes(o.data[offset:])  # copy-ok: read materialisation, survives later writes
             else:
-                out = bytes(o.data[offset:offset + length])
+                out = bytes(o.data[offset:offset + length])  # copy-ok: read materialisation, survives later writes
         if faults._ACTIVE and faults.fires("store.bit_rot"):
             # silent media corruption: the store returns success with
             # one flipped byte — only crc verification above can tell
@@ -251,7 +254,7 @@ class MemStore(ObjectStore):
     def export_state(self) -> Dict:
         with self._lock:
             return {
-                cid: {oid: {"data": bytes(o.data).hex(),
+                cid: {oid: {"data": bytes(o.data).hex(),  # copy-ok: checkpoint export, off the data path
                             "xattr": {k: v.hex()
                                       for k, v in o.xattr.items()},
                             "omap": {k: v.hex()

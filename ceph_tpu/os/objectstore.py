@@ -54,7 +54,13 @@ class Transaction:
 
     def write(self, cid: str, oid: str, offset: int,
               data: bytes) -> "Transaction":
-        self.ops.append((OP_WRITE, cid, oid, offset, bytes(data)))
+        """``data`` may be any buffer-protocol object (bytes, or a
+        memoryview into a pooled recv segment) — it is staged AS IS,
+        zero-copy.  The contract is the reference's bufferlist one:
+        the buffer must stay valid until queue_transaction returns
+        (both stores materialise into their own image inside it, and
+        every caller queues within the handler that owns the view)."""
+        self.ops.append((OP_WRITE, cid, oid, offset, data))
         return self
 
     def zero(self, cid: str, oid: str, offset: int,
@@ -76,6 +82,8 @@ class Transaction:
 
     def setattr(self, cid: str, oid: str, key: str,
                 value: bytes) -> "Transaction":
+        # copy-ok: attr values are tiny metadata (version stamps) the
+        # store retains by reference past the caller's buffer lifetime
         self.ops.append((OP_SETATTR, cid, oid, key, bytes(value)))
         return self
 
@@ -85,8 +93,10 @@ class Transaction:
 
     def omap_setkeys(self, cid: str, oid: str,
                      kv: Dict[str, bytes]) -> "Transaction":
+        # omap values are small keys/records the store retains by
+        # reference past the caller's buffer lifetime
         self.ops.append((OP_OMAP_SETKEYS, cid, oid,
-                         {k: bytes(v) for k, v in kv.items()}))
+                         {k: bytes(v) for k, v in kv.items()}))  # copy-ok: small omap records, retained by reference
         return self
 
     def omap_rmkeys(self, cid: str, oid: str,

@@ -71,6 +71,7 @@ def _pack_body(body: bytes, comp) -> Tuple[int, bytes]:
         return _MAGIC, body
     packed = comp.compress(body)
     tag = comp.name.encode()
+    # copy-ok: one-byte compressor-tag length header, not payload
     return _MAGIC_Z, bytes([len(tag)]) + tag + packed
 
 
@@ -155,7 +156,8 @@ def encode_checkpoint(seq: int,
         for oid in sorted(objs):
             o = objs[oid]
             enc.str_(oid)
-            enc.blob(bytes(o.data))
+            enc.blob(o.data)  # staged by reference; materialised by
+            # the enc.bytes() join below, under the store lock
             enc.str_blob_map(o.xattr)
             enc.str_blob_map(o.omap)
     enc.finish()

@@ -432,7 +432,11 @@ class OSDService(MapFollower):
                 txn = Transaction()
                 if not self.store.collection_exists(cid):
                     txn.create_collection(cid)
-                data = bytes(msg["data"])
+                # buffer-protocol payload (a view into the frame's
+                # pooled recv segment): staged zero-copy — the store
+                # materialises it into its own image inside
+                # queue_transaction, before this handler returns
+                data = msg["data"]
                 txn.write(cid, oid, 0, data)
                 # a shorter rewrite must never leave a stale tail:
                 # chunk boundaries shift and EC decode would interleave
@@ -758,7 +762,10 @@ class OSDService(MapFollower):
         pool_id, ps = int(msg["pool"]), int(msg["ps"])
         oid = msg["oid"]
         offset = int(msg["offset"])
-        data = bytes(msg["data"])
+        # zero-copy staging: a view into the pooled recv segment is
+        # fine here — every use below copies it into the merge buffer
+        # before this handler (and thus the segment's lifetime) ends
+        data = msg["data"]
         m = self._map_for_op(msg)
         if m is None:
             return {"error": "no map"}
@@ -820,18 +827,18 @@ class OSDService(MapFollower):
                     tags={"bytes": len(buf), "k": k, "m": n - k}):
                 # through the coalescer: concurrent writes to other
                 # PGs of this pool share one batched dispatch
-                chunks = self._ec_batcher.encode(code, range(n),
-                                                 bytes(buf))
+                chunks = self._ec_batcher.encode(code, range(n), buf)
                 payloads = [np.asarray(chunks[p], np.uint8).tobytes()
                             for p in range(n)]
-            # EC input-assembly copies: the mutable merge buffer, the
-            # immutable bytes() handed to the engine, and one
-            # device->host tobytes() per chunk — the host-copy tax
-            # the zero-copy Pallas path (ROADMAP item 2) must cut
+            # EC input-assembly copies: the mutable merge buffer (the
+            # engine wraps it zero-copy via np.frombuffer) and one
+            # device->host tobytes() per chunk — each a deliberate,
+            # booked materialisation; the former bytes(buf) handoff
+            # copy is gone (ROADMAP item 2)
             copytrack.book_pc(
                 self._copy_pc, "ec_assembly",
-                2 * len(buf) + sum(len(p) for p in payloads),
-                copies=2 + n)
+                len(buf) + sum(len(p) for p in payloads),
+                copies=1 + n)
             # distribute; a `superseded` reply means some holder has a
             # NEWER stored version our floor probe missed (our own
             # shard degraded) — counting it as landed would ack a
@@ -2239,8 +2246,11 @@ class OSDService(MapFollower):
         reply (so callers can distinguish `superseded` — the holder
         kept its newer version — from a genuine persist) or None on
         transport failure."""
+        # every caller hands a stable bytes payload (a device->host
+        # tobytes() or an already-materialised shard) — no defensive
+        # re-copy here
         msg = {"type": "shard_write", "pool": pool_id, "ps": ps,
-               "oid": oid, "shard": shard, "data": bytes(data),
+               "oid": oid, "shard": shard, "data": data,
                "size": size, "v": v, "qos_class": qos}
         if force:
             msg["force"] = True
@@ -2264,7 +2274,7 @@ class OSDService(MapFollower):
         if qos == "recovery" and rep is not None and rep.get("ok"):
             self.pc.inc("recovery_bytes", len(msg["data"]))
             # recovery-push copy: the decoded shard is materialised
-            # once into the push frame (bytes(data) above)
+            # once (the caller's device->host tobytes()) for the push
             copytrack.book_pc(self._copy_pc, "recovery_push",
                               len(msg["data"]), copies=1)
             self._account_io(pool_id, ps,

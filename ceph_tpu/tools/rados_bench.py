@@ -189,7 +189,8 @@ def bench_minicluster(op: str = "write", seconds: float = 5.0,
                       concurrent: int = 8, object_size: int = 1 << 16,
                       n_osds: int = 4, ec: bool = False,
                       pg_num: int = 16, qd: Optional[int] = None,
-                      qd_sweep: Optional[List[int]] = None) -> Dict:
+                      qd_sweep: Optional[List[int]] = None,
+                      ec_engine: str = "") -> Dict:
     """One-shot: boot a MiniCluster, run write (then optionally a read
     phase), return the summary dict.
 
@@ -197,7 +198,11 @@ def bench_minicluster(op: str = "write", seconds: float = 5.0,
     that queue depth instead of ``concurrent`` synchronous threads.
     ``qd_sweep``: run one aio write phase per depth and report the
     best (plus the whole sweep under ``qd_sweep``) — the knee of that
-    curve is the cluster's write pipeline capacity."""
+    curve is the cluster's write pipeline capacity.
+
+    ``ec_engine``: EC engine profile key for the EC pool(s) —
+    '', 'native', 'bitplane' or 'pallas-fused'; the resolved choice
+    is recorded in the copy block as ``engine``."""
     from ..common.config import Config
     from ..services.cluster import MiniCluster
 
@@ -211,11 +216,12 @@ def bench_minicluster(op: str = "write", seconds: float = 5.0,
     cluster = MiniCluster(n_osds=n_osds, config=conf).start()
     try:
         if ec:
-            cluster.create_ec_pool(
-                1, "bench21", {"plugin": "jerasure",
-                               "technique": "reed_sol_van",
-                               "k": "2", "m": "1", "w": "8"},
-                pg_num=pg_num)
+            prof = {"plugin": "jerasure",
+                    "technique": "reed_sol_van",
+                    "k": "2", "m": "1", "w": "8"}
+            if ec_engine:
+                prof["engine"] = ec_engine
+            cluster.create_ec_pool(1, "bench21", prof, pg_num=pg_num)
         else:
             cluster.create_replicated_pool(
                 1, pg_num=pg_num, size=min(3, n_osds))
@@ -270,6 +276,26 @@ def bench_minicluster(op: str = "write", seconds: float = 5.0,
                                 concurrent=2)
         attr_bench.write(min(1.0, seconds))
         conf.set("trace_sample_rate", 0.0)
+
+        # EC write burst: the copy ledger's ec_assembly site books
+        # only on the EC write lane, so a replicated-only bench run
+        # would report 0 there forever (the r13 records did exactly
+        # that).  Always push a short burst through an EC pool before
+        # the ledger snapshot so every site carries real traffic.
+        ec_pool = 1
+        if not ec:
+            ec_pool = 2
+            prof = {"plugin": "jerasure",
+                    "technique": "reed_sol_van",
+                    "k": "2", "m": "1", "w": "8"}
+            if ec_engine:
+                prof["engine"] = ec_engine
+            cluster.create_ec_pool(ec_pool, "benchec", prof,
+                                   pg_num=8)
+        ec_cli = cluster.client("bench-ec")
+        ObjBencher(ec_cli, ec_pool, object_size=object_size,
+                   concurrent=2).write(min(1.0, seconds))
+
         snap = _tel.cluster_snapshot(cluster.asok_dir)
         folds = _attr.fold_spans(_tel.gather_spans(snap))
         agg = _attr.StageAggregator()
@@ -317,6 +343,8 @@ def bench_minicluster(op: str = "write", seconds: float = 5.0,
                                    "ec_assembly",
                                    "recovery_push")},
         }
+        from ..ec.native_gf import engine_choice
+        out["copy"]["engine"] = engine_choice(ec_engine)
 
         # profiler overhead: the same short write burst with the
         # wallclock sampler off vs on at profiler_hz (100 Hz default)

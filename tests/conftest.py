@@ -78,7 +78,7 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 GOLDEN_DIR = pathlib.Path(__file__).resolve().parent / "golden"
 
 from ceph_tpu.analysis import jaxcheck, lockdep, watchdog  # noqa: E402
-from ceph_tpu.common import tracing  # noqa: E402
+from ceph_tpu.common import bufpool, tracing  # noqa: E402
 
 # -- JAX hygiene gates (the XLA twin of the concurrency gates below) --
 #
@@ -152,7 +152,14 @@ def _concurrency_gate(request):
        cross-test interference that made the quorum rejoin test
        flaky) get a grace period to die, then a warning.  Either way
        the NEXT test starts from a quiesced process.
-    3. Span leak: every tracing span opened during the test must be
+    3. Buffer leak: every pooled recv segment acquired during the
+       test must be released by test end (after the thread quiesce) —
+       a held segment means a messenger/dispatch path dropped its
+       ``Segment.release()``, the use-after-free-in-waiting the
+       refcount contract exists to catch.  Like the span gate, live
+       daemon threads (a shared cluster fixture still draining) may
+       yet release — warn instead of fail.
+    4. Span leak: every tracing span opened during the test must be
        finished by test end (after the thread quiesce above).  A span
        left open with no daemon thread alive to ever finish it means a
        code path began a span outside a ``with`` (lint CONC004's
@@ -164,6 +171,7 @@ def _concurrency_gate(request):
     """
     before = set(threading.enumerate())
     before_spans = {id(s) for _svc, s in tracing.active_spans()}
+    before_segs = len(bufpool.outstanding())
     base = len(lockdep.violations())
     yield
     vs = lockdep.violations()[base:]
@@ -201,6 +209,27 @@ def _concurrency_gate(request):
             f"{request.node.nodeid} leaked daemon thread(s): "
             f"{sorted(t.name for t in left)[:10]}"
             f"{'...' if len(left) > 10 else ''}")
+
+    # bufpool leak gate: in-flight dispatch gets a short drain window;
+    # comparing against the BEFORE count means a segment stuck forever
+    # fails only the test that leaked it, not every later one
+    seg_deadline = time.monotonic() + 2.0
+    held = bufpool.outstanding()
+    while len(held) > before_segs and time.monotonic() < seg_deadline:
+        time.sleep(0.05)
+        held = bufpool.outstanding()
+    if len(held) > before_segs:
+        detail = "\n".join(f"- tag={tag!r} nbytes={n}"
+                           for tag, n in held[:20])
+        if left:
+            warnings.warn(
+                f"{request.node.nodeid}: {len(held) - before_segs} "
+                f"pooled segment(s) still held at test end:\n{detail}")
+        else:
+            pytest.fail(
+                f"{len(held) - before_segs} pooled buffer segment(s) "
+                f"leaked (acquired during this test, never "
+                f"released):\n{detail}")
 
     # span-leak gate: give in-flight ops a short drain window (the
     # thread gate above already quiesced daemon threads)

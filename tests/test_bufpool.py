@@ -1,0 +1,225 @@
+"""Pooled buffer plane (common/bufpool) — lifecycle, recycling, leak
+accounting, and the view-outlives-frame safety contract under the
+messenger's session reset/replay machinery (ROADMAP item 2).
+"""
+
+import gc
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.common import bufpool
+from ceph_tpu.common.bufpool import BufferPool, DoubleRelease
+from ceph_tpu.msg.messenger import Messenger
+
+
+# -- pool lifecycle ---------------------------------------------------
+
+def test_acquire_release_recycles_buffer():
+    pool = BufferPool()
+    seg = pool.acquire(4096, tag="t1")
+    assert seg.nbytes == 4096
+    assert len(pool.outstanding()) == 1
+    buf_id = id(seg._buf)
+    seg.release()
+    assert pool.outstanding() == []
+    # same size class comes back as the SAME underlying buffer
+    seg2 = pool.acquire(3000, tag="t2")
+    assert id(seg2._buf) == buf_id
+    seg2.release()
+    d = pool._counters().dump()
+    assert d["pool_hits"] == 1
+    assert d["pool_misses"] == 1
+    assert d["acquires"] == 2 and d["releases"] == 2
+    assert d["live_segments"] == 0 and d["live_bytes"] == 0
+
+
+def test_size_classes_are_powers_of_two():
+    pool = BufferPool()
+    for n, want in [(1, 1024), (1024, 1024), (1025, 2048),
+                    (100_000, 131072)]:
+        seg = pool.acquire(n)
+        assert len(seg._buf) == want, n
+        assert seg.nbytes == n
+        assert len(seg.writable()) == n
+        seg.release()
+
+
+def test_oversized_request_served_unpooled():
+    pool = BufferPool()
+    n = (1 << 24) + 1  # above the largest retained class
+    seg = pool.acquire(n, tag="big")
+    assert len(seg._buf) == n
+    seg.release()
+    assert pool.free_buffers() == 0  # never retained
+    assert pool._counters().dump()["pool_misses"] == 1
+
+
+def test_free_list_bounded_per_class():
+    pool = BufferPool(per_class=2)
+    segs = [pool.acquire(2048) for _ in range(5)]
+    for s in segs:
+        s.release()
+    assert pool.free_buffers() == 2
+
+
+def test_incref_extends_lifetime_across_handoff():
+    pool = BufferPool()
+    seg = pool.acquire(512, tag="handoff")
+    seg.incref()
+    seg.release()
+    # still held by the second reference: view stays valid
+    view = seg.view()
+    view[:3] = b"abc"
+    assert bytes(seg.view(0, 3)) == b"abc"
+    assert len(pool.outstanding()) == 1
+    seg.release()
+    assert pool.outstanding() == []
+
+
+def test_double_release_raises():
+    pool = BufferPool()
+    seg = pool.acquire(256)
+    seg.release()
+    with pytest.raises(DoubleRelease):
+        seg.release()
+    with pytest.raises(DoubleRelease):
+        seg.incref()  # resurrection is the same bug class
+
+
+def test_gc_leak_is_counted_not_silent():
+    pool = BufferPool()
+    seg = pool.acquire(1024, tag="leaky")
+    before = pool._counters().dump()["leaked_segments"]
+    del seg  # dropped while still referenced
+    gc.collect()
+    d = pool._counters().dump()
+    assert d["leaked_segments"] == before + 1
+    assert d["live_segments"] == 0 and d["live_bytes"] == 0
+    assert pool.outstanding() == []
+    # the buffer itself was reclaimed into the free list
+    assert pool.free_buffers() == 1
+
+
+def test_concurrent_acquire_release_consistent():
+    pool = BufferPool()
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(200):
+                seg = pool.acquire(4096, tag="conc")
+                seg.view()[:4] = b"\xde\xad\xbe\xef"
+                seg.release()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    ths = [threading.Thread(target=worker) for _ in range(8)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    assert not errors
+    assert pool.outstanding() == []
+    d = pool._counters().dump()
+    assert d["acquires"] == d["releases"] == 8 * 200
+
+
+# -- messenger integration: views must not outlive their frame --------
+
+def test_reply_payload_survives_segment_recycling():
+    """A call() reply blob is handed to the caller AFTER its pooled
+    recv segment is released.  If the messenger returned a raw view,
+    the next recv into the recycled buffer would rewrite the caller's
+    bytes under it — so replies must be materialised (and booked)."""
+    server = Messenger("bp-server")
+    client = Messenger("bp-client")
+    server.start()
+    client.start()
+    try:
+        server.register(
+            "get", lambda m: {"ok": True, "data": b"\xaa" * 2000})
+        rep = client.call(server.addr, {"type": "get"}, timeout=5)
+        got = rep["data"]
+        snapshot = bytes(got)
+        # hammer the SAME connection so recycled recv segments are
+        # rewritten many times over
+        for i in range(20):
+            client.call(server.addr,
+                        {"type": "get", "i": i}, timeout=5)
+        assert bytes(got) == snapshot == b"\xaa" * 2000
+    finally:
+        client.shutdown()
+        server.shutdown()
+
+
+def test_request_views_stable_through_session_reset_and_replay():
+    """The satellite-3 safety drill: request blobs reach handlers as
+    views into pooled segments; killing the transport mid-stream
+    forces session reset + frame replay.  Every handler must observe
+    its payload intact (no recycled-buffer aliasing), and the pool
+    must drain back to empty."""
+    server = Messenger("rs-server", lossless=True)
+    client = Messenger("rs-client", lossless=True)
+    server.start()
+    client.start()
+    corrupt = []
+    payload = lambda n: bytes([n & 0xFF]) * 1500  # noqa: E731
+
+    def h(msg):
+        data = msg["data"]
+        want = payload(msg["n"])
+        # read twice with a scheduling gap between — an aliased
+        # recycled buffer would tear between the reads
+        first = bytes(data)
+        time.sleep(0.001)
+        if first != want or bytes(data) != want:
+            corrupt.append(msg["n"])
+        return {"ok": True, "n": msg["n"]}
+
+    server.register("put", h)
+    errors = []
+    N, WRITERS = 40, 3
+
+    def writer(w):
+        for i in range(N):
+            n = w * N + i
+            try:
+                rep = client.call(
+                    server.addr,
+                    {"type": "put", "n": n, "data": payload(n)},
+                    timeout=20)
+                assert rep.get("n") == n
+            except Exception as e:  # pragma: no cover
+                errors.append((n, e))
+
+    ths = [threading.Thread(target=writer, args=(w,))
+           for w in range(WRITERS)]
+    for t in ths:
+        t.start()
+    for _ in range(4):
+        time.sleep(0.1)
+        with client._conn_lock:
+            socks = list(client._conns.values())
+        for s in socks:
+            try:
+                s.close()  # RST under the session layer -> replay
+            except OSError:
+                pass
+    for t in ths:
+        t.join()
+    try:
+        assert not errors, f"lost ops: {errors[:3]}"
+        assert not corrupt, \
+            f"payload corrupted for ops {sorted(corrupt)[:10]} — " \
+            f"a view outlived its pooled segment"
+    finally:
+        client.shutdown()
+        server.shutdown()
+    # drained: the per-test conftest gate re-checks this, but assert
+    # here too so the failure names THIS contract
+    deadline = time.monotonic() + 2.0
+    while bufpool.outstanding() and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert bufpool.outstanding() == []

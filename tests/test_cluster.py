@@ -124,6 +124,56 @@ def test_ec_write_read(cluster):
     assert c.get(2, "obj-e") == data
 
 
+def test_copy_ledger_books_every_site(cluster, monkeypatch):
+    """Satellite regression: r13 shipped ec_assembly=0 in every BENCH
+    record because the write lane's booking was dropped.  After an EC
+    write burst (plus one real recovery push) every copy-ledger site
+    must carry nonzero traffic — a zero site means its call path lost
+    the booking, not that the path went copy-free."""
+    from ceph_tpu.common import copytrack
+    from ceph_tpu.msg import messenger as _msgr
+
+    c = cluster.client("ledger")
+    for i in range(8):
+        c.put(2, f"obj-cl{i}", bytes(range(256)) * 16)
+
+    # the uncontended sendmsg fast path books nothing (no userspace
+    # join happens), so "send booked zero" would be correct-and-green
+    # there; drive a couple of writes down the join fallback so the
+    # send site's booking itself is exercised deterministically
+    monkeypatch.setattr(_msgr, "_HAS_SENDMSG", False)
+    for i in range(2):
+        c.put(2, f"obj-cl-join{i}", bytes(range(256)) * 16)
+    monkeypatch.setattr(_msgr, "_HAS_SENDMSG", True)
+
+    # recovery_push books only on the recovery lane: drive one real
+    # push to a remote holder under recovery QoS
+    src = cluster.osds[min(cluster.osds)]
+    dst = next(i for i in cluster.osds if i != src.id)
+    blob = b"recovered-shard" * 64
+    rep = src._push_shard(2, 0, dst, "obj-cl-push", 0, blob,
+                          len(blob), None, qos="recovery")
+    assert rep is not None and rep.get("ok")
+    # the pushed shard is an orphan (1 shard of a k=2,m=2 object that
+    # never existed) — tombstone it so the module-scoped cluster's
+    # later health/recovery tests don't inherit an unrecoverable pg
+    cluster.osds[dst]._h_obj_delete(
+        {"type": "obj_delete", "pool": 2, "ps": 0,
+         "oid": "obj-cl-push", "v": None, "force": True})
+
+    totals = {}
+    for svc in cluster.osds.values():
+        for k, v in svc.ctx.perf.dump().get(
+                copytrack.LOGGER, {}).items():
+            if isinstance(v, (int, float)):
+                totals[k] = totals.get(k, 0) + v
+    for site in copytrack.SITES:
+        assert totals.get(f"{site}_bytes", 0) > 0, \
+            f"copy-ledger site {site!r} booked zero bytes"
+        assert totals.get(f"{site}_copies", 0) > 0, \
+            f"copy-ledger site {site!r} booked zero copies"
+
+
 def test_degraded_read_and_recovery(cluster):
     """The full elastic-recovery loop: kill an OSD holding a shard,
     reads still succeed degraded, mon marks it down, the remapped OSD

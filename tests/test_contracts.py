@@ -22,8 +22,9 @@ EXPECTED_CONTRACTS = {
     "ec.engine.mod2_matmul", "ec.engine.encode_batched",
     "ec.engine.encode_batched_sharded", "ec.rs_jax",
     "ec.jerasure", "ec.isa", "ec.lrc", "ec.shec", "ec.clay",
-    "ec.native_gf", "ec.pallas", "crush.mapper_jax",
-    "crush.mapper_spec", "parallel.sharded_rule_fn",
+    "ec.native_gf", "ec.pallas", "ec.pallas_engine",
+    "crush.mapper_jax", "crush.mapper_spec",
+    "parallel.sharded_rule_fn",
 }
 
 

@@ -729,6 +729,76 @@ def test_obs002_profile_start_exempt_paths(tmp_path):
     assert lint_obs.lint_file(b) == []
 
 
+def _clint(tmp_path, source, rel="msg/peer.py"):
+    f = tmp_path / rel
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    return lint_obs.lint_file(f)
+
+
+def test_copy001_hot_path_copies_flagged(tmp_path):
+    src = """
+        def rx(view, arr):
+            a = bytes(view)
+            b = arr.tobytes()
+            return a, b
+    """
+    vs = _clint(tmp_path, src)
+    assert codes(vs) == ["COPY001", "COPY001"]
+    assert "bytes(...)" in vs[0].message
+    assert ".tobytes()" in vs[1].message
+    # the EC hot files are in scope by suffix; siblings are not
+    assert codes(_clint(tmp_path, src, rel="ec/engine.py")) == \
+        ["COPY001", "COPY001"]
+    assert codes(_clint(tmp_path, src, rel="ec/batcher.py")) == \
+        ["COPY001", "COPY001"]
+    assert _clint(tmp_path, src, rel="ec/registry.py") == []
+    # the same source outside the hot data plane is not flagged,
+    # and tests are exempt even under a hot directory name
+    assert _clint(tmp_path, src, rel="tools/cli.py") == []
+    assert _clint(tmp_path, src, rel="tests/msg/test_rx.py") == []
+
+
+def test_copy001_suppression_requires_reason(tmp_path):
+    # same-line mark with a reason
+    assert _clint(tmp_path, """
+        def ok(view):
+            return bytes(view)  # copy-ok: reply payload must outlive the recv segment
+    """) == []
+    # mark in the comment block directly above the call
+    assert _clint(tmp_path, """
+        def ok(arr):
+            # copy-ok: materialised once at the session boundary and
+            # handed to the store by reference
+            return arr.tobytes()
+    """) == []
+    # a bare mark with no reason does not count — the reason is the
+    # point of the rule
+    vs = _clint(tmp_path, """
+        def bad(view):
+            return bytes(view)  # copy-ok:
+    """)
+    assert codes(vs) == ["COPY001"]
+    # a mark separated from the call by code does not reach it
+    vs = _clint(tmp_path, """
+        def bad(view):
+            # copy-ok: too far away
+            n = len(view)
+            return bytes(view)
+    """)
+    assert codes(vs) == ["COPY001"]
+
+
+def test_copy001_non_copy_shapes_not_flagged(tmp_path):
+    assert _clint(tmp_path, """
+        def fine(enc, s):
+            a = enc.bytes()        # an encoder method, not a copy
+            b = bytes()            # empty construction
+            c = bytes(s, "utf-8")  # str encode, not a buffer copy
+            return a, b, c
+    """) == []
+
+
 def test_obs_cli_exit_status(tmp_path):
     import subprocess
     import sys

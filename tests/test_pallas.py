@@ -50,3 +50,143 @@ def test_fused_decode_matrix():
     got = np.asarray(fused_gf2_matmul_w8(inv, stack, interpret=True))
     assert np.array_equal(got, want)
     assert np.array_equal(want, data)  # and it IS the decode
+
+
+# -- the registry-promoted 'pallas-fused' engine ----------------------
+#
+# engine=pallas-fused in a pool profile routes the plugin's BitCode
+# through the fused kernel unconditionally (interpret mode on CPU).
+# Parity is pinned byte-for-byte against the bit-plane engine over the
+# golden-corpus profile grid's byte-layout (w=8 matrix) members — the
+# same object/seed the ec_parity.json corpus uses.
+
+# every byte-layout (w=8 matrix) profile of the corpus grid
+# (tests/golden/_gen_ec_parity.py CONFIGS), plus the isa plugin's two
+# techniques at the reference defaults
+_W8_GRID = [
+    ("jerasure", {"technique": "reed_sol_van", "k": "2", "m": "2",
+                  "w": "8"}),
+    ("jerasure", {"technique": "reed_sol_van", "k": "4", "m": "3",
+                  "w": "8"}),
+    ("jerasure", {"technique": "reed_sol_r6_op", "k": "4", "m": "2",
+                  "w": "8"}),
+    ("isa", {"technique": "reed_sol_van", "k": "7", "m": "3"}),
+    ("isa", {"technique": "cauchy", "k": "4", "m": "2"}),
+]
+
+_OBJECT_SIZE = 1537  # corpus object: deliberately unaligned
+
+
+def _grid_pair(plugin, prof):
+    from ceph_tpu.ec.registry import factory
+
+    fused = factory(plugin, dict(prof, engine="pallas-fused"))
+    plain = factory(plugin, dict(prof, engine="bitplane"))
+    assert fused._code.force_fused
+    assert not plain._code.force_fused
+    return fused, plain
+
+
+@pytest.mark.parametrize("plugin,prof", _W8_GRID,
+                         ids=[f"{p}-{c['technique']}-k{c['k']}m{c['m']}"
+                              for p, c in _W8_GRID])
+def test_pallas_engine_corpus_grid_encode_parity(plugin, prof):
+    fused, plain = _grid_pair(plugin, prof)
+    rng = np.random.default_rng(0xEC)
+    raw = rng.integers(0, 256, _OBJECT_SIZE, dtype=np.uint8).tobytes()
+    n = fused.get_chunk_count()
+    a = fused.encode(range(n), raw)
+    b = plain.encode(range(n), raw)
+    for i in range(n):
+        assert np.array_equal(np.asarray(a[i]), np.asarray(b[i])), \
+            f"chunk {i} differs between pallas-fused and bit-plane"
+
+
+@pytest.mark.parametrize("plugin,prof", _W8_GRID[:3],
+                         ids=[f"{p}-{c['technique']}-k{c['k']}m{c['m']}"
+                              for p, c in _W8_GRID[:3]])
+def test_pallas_engine_corpus_grid_batched_parity(plugin, prof):
+    fused, plain = _grid_pair(plugin, prof)
+    k = fused.get_data_chunk_count()
+    rng = np.random.default_rng(0xEC ^ k)
+    B, L = 5, 512
+    stripes = rng.integers(0, 256, (B, k, L), dtype=np.uint8)
+    a = np.asarray(fused._code.encode_batched(stripes, mesh=None))
+    b = np.asarray(plain._code.encode_batched(stripes, mesh=None))
+    assert np.array_equal(a, b)
+    # and batched == B independent per-stripe encodes
+    for s in range(B):
+        assert np.array_equal(
+            a[s], np.asarray(fused._code.encode(stripes[s])))
+
+
+def test_pallas_engine_mesh_parity():
+    import jax
+
+    from ceph_tpu.parallel.placement import make_mesh
+
+    fused, plain = _grid_pair("jerasure",
+                              {"technique": "reed_sol_van", "k": "4",
+                               "m": "2", "w": "8"})
+    rng = np.random.default_rng(7)
+    stripes = rng.integers(0, 256, (6, 4, 512), dtype=np.uint8)
+    want = np.asarray(plain._code.encode_batched(stripes, mesh=None))
+    mesh = make_mesh(jax.devices(), axis_name="ec")
+    got = np.asarray(fused._code.encode_batched_sharded(stripes, mesh))
+    assert np.array_equal(got, want)
+
+
+def test_pallas_engine_decode_roundtrip():
+    fused, _plain = _grid_pair("jerasure",
+                               {"technique": "reed_sol_van", "k": "4",
+                                "m": "2", "w": "8"})
+    rng = np.random.default_rng(11)
+    raw = rng.integers(0, 256, 4096, dtype=np.uint8).tobytes()
+    n = fused.get_chunk_count()
+    chunks = fused.encode(range(n), raw)
+    # lose one data + one parity chunk; recover through the fused
+    # kernel's decode-matrix path
+    have = {i: chunks[i] for i in range(n) if i not in (0, 4)}
+    out = fused.decode(range(n), have, 0)
+    for i in range(n):
+        assert np.array_equal(np.asarray(out[i]),
+                              np.asarray(chunks[i]))
+
+
+def test_pallas_engine_recompile_budget():
+    """Steady-state batched encodes at a FIXED shape through the
+    fused engine must hit the jit cache — the recompile gate in
+    conftest turns any violation into a failure, but assert locally
+    too so this test names the contract."""
+    from ceph_tpu.analysis import jaxcheck
+
+    fused, _plain = _grid_pair("jerasure",
+                               {"technique": "reed_sol_van", "k": "4",
+                                "m": "2", "w": "8"})
+    rng = np.random.default_rng(13)
+    stripes = rng.integers(0, 256, (4, 4, 512), dtype=np.uint8)
+    fused._code.encode_batched(stripes, mesh=None)  # warm
+    base = len(jaxcheck.recompile_violations())
+    with jaxcheck.steady_state("pallas-fused batched encode"):
+        for _ in range(3):
+            fused._code.encode_batched(stripes, mesh=None)
+    assert jaxcheck.recompile_violations()[base:] == []
+
+
+def test_engine_profile_key_validated():
+    from ceph_tpu.ec.interface import ErasureCodeError
+    from ceph_tpu.ec.registry import factory
+
+    with pytest.raises(ErasureCodeError):
+        factory("jerasure", {"technique": "reed_sol_van", "k": "2",
+                             "m": "1", "w": "8", "engine": "cuda"})
+    # fused engine is a byte-layout engine: w=16 and packet
+    # techniques must reject it at profile parse, not fall back
+    with pytest.raises(ErasureCodeError):
+        factory("jerasure", {"technique": "reed_sol_van", "k": "3",
+                             "m": "2", "w": "16",
+                             "engine": "pallas-fused"})
+    with pytest.raises(ErasureCodeError):
+        factory("jerasure", {"technique": "cauchy_good", "k": "4",
+                             "m": "2", "w": "8", "packetsize": "8",
+                             "engine": "pallas-fused"})

@@ -317,6 +317,35 @@ def test_perf_history_regression_check(tmp_path):
     assert perf_history.main([str(tmp_path), "--check"]) == 1
 
 
+def test_perf_history_zero_copy_goal_gate(tmp_path):
+    """copy_bytes_per_op is gated absolutely from r14 on: a run above
+    0.6x the r13 baseline (191,330 -> goal 114,798) red-checks even
+    when the run-over-run delta stays inside the drift threshold."""
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve()
+                           .parent.parent))
+    from tools import perf_history
+
+    def write_run(n, bpo):
+        cl = json.dumps({"copy": {"bytes_per_op": bpo}})
+        (tmp_path / f"BENCH_r{n:02d}.json").write_text(json.dumps({
+            "n": n, "cmd": "bench", "rc": 0,
+            "tail": "# cluster json: " + cl,
+            "parsed": {"value": 100000.0, "platform": "cpu"}}))
+
+    write_run(13, 191330.0)  # the baseline run itself is not gated
+    write_run(14, 110000.0)  # under the goal: ok
+    assert perf_history.main([str(tmp_path), "--check"]) == 0
+    write_run(14, 120000.0)  # a 37% cut, but above the 114,798 goal
+    assert perf_history.main([str(tmp_path), "--check"]) == 1
+    rows = perf_history.load_all(str(tmp_path))
+    perf_history.compute_deltas(rows)
+    assert any("zero-copy goal" in r
+               for r in rows[-1]["regressions"])
+
+
 # -- telemetry history/top views --------------------------------------------
 
 def _hist_sample(ts, mono, bytes_out):

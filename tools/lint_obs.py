@@ -36,6 +36,19 @@ OBS002  the continuous-profiling plane must stay in sync with the
             does behind ``if sub == "start":``) or add
             ``# obs-ok: <reason>``.
 
+COPY001 a ``bytes(...)`` (single-argument) or ``.tobytes()`` call in a
+        hot-path data-plane module (``msg/``, ``os/``,
+        ``ec/engine.py``, ``ec/batcher.py``) without a
+        ``# copy-ok: <reason>`` suppression naming why the copy is
+        deliberate.  The zero-copy buffer plane (ROADMAP item 2)
+        threads memoryviews from recv_into through the frame codec,
+        the store staging, and the EC encode input; every remaining
+        materialisation on those paths must be a DECISION — booked in
+        the ``obs.copy`` ledger and justified in place — or it is
+        exactly the silent re-copy the plane exists to delete.  The
+        reason is mandatory; the mark may sit on the call line or an
+        immediately preceding comment line.
+
 Name resolution, in order:
 - a literal string: checked directly against the registry;
 - a Name bound by an enclosing ``for <name> in (<literals>,)`` loop
@@ -68,6 +81,20 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 from ceph_tpu.common.counters import all_names, declared  # noqa: E402
 
 SUPPRESS_MARK = "obs-ok:"
+COPY_MARK = "copy-ok:"
+
+# hot-path data-plane scope for COPY001: the messenger, the stores,
+# and the EC dispatch seam (the engine/batcher pair the views feed)
+_COPY_HOT_SUFFIXES = ("ec/engine.py", "ec/batcher.py")
+
+
+def copy_hot_path(path) -> bool:
+    """True when ``path`` is in COPY001's hot-path scope."""
+    p = pathlib.Path(path)
+    if "tests" in p.parts:
+        return False
+    return "msg" in p.parts or "os" in p.parts or \
+        p.as_posix().endswith(_COPY_HOT_SUFFIXES)
 
 # paths allowed to call profile_start unconditionally: tests drive the
 # sampler directly, and the bench lanes switch it on around a measured
@@ -97,6 +124,26 @@ def _suppressed(source_lines: List[str], lineno: int) -> bool:
     return False
 
 
+def _copy_suppressed(source_lines: List[str], lineno: int) -> bool:
+    """``# copy-ok: <reason>`` on the call line or on the comment
+    line(s) immediately above it; the reason text is mandatory."""
+
+    def has_reason(line: str) -> bool:
+        at = line.find(COPY_MARK)
+        return at >= 0 and bool(line[at + len(COPY_MARK):].strip())
+
+    if not (1 <= lineno <= len(source_lines)):
+        return False
+    if has_reason(source_lines[lineno - 1]):
+        return True
+    i = lineno - 2
+    while i >= 0 and source_lines[i].strip().startswith("#"):
+        if has_reason(source_lines[i]):
+            return True
+        i -= 1
+    return False
+
+
 def _receiver_name(func: ast.expr) -> Optional[str]:
     """`pc.inc` -> 'pc'; `self.pc.inc` -> 'pc'; `a.b._pc.inc` ->
     '_pc' (the attribute the method hangs off)."""
@@ -112,12 +159,14 @@ def _receiver_name(func: ast.expr) -> Optional[str]:
 
 class _Checker(ast.NodeVisitor):
     def __init__(self, path: str, source: str,
-                 profile_exempt: bool = False):
+                 profile_exempt: bool = False,
+                 copy_hot: bool = False):
         self.path = path
         self.lines = source.splitlines()
         self.violations: List[Violation] = []
         self.registry = all_names()
         self.profile_exempt = profile_exempt
+        self.copy_hot = copy_hot
         # Name -> literal candidates, from enclosing `for x in (...)`
         self._loop_bindings: dict = {}
         self._if_depth = 0
@@ -160,6 +209,21 @@ class _Checker(ast.NodeVisitor):
                 "the wallclock sampler must be off by default; gate "
                 "the call behind an `if` (admin-verb dispatch) or "
                 "add `# obs-ok: <reason>`"))
+        if self.copy_hot:
+            copies = (isinstance(func, ast.Name) and func.id == "bytes"
+                      and len(node.args) == 1) or \
+                (isinstance(func, ast.Attribute)
+                 and func.attr == "tobytes")
+            if copies and not _copy_suppressed(self.lines,
+                                               node.lineno):
+                what = "bytes(...)" if isinstance(func, ast.Name) \
+                    else ".tobytes()"
+                self.violations.append(Violation(
+                    "COPY001", self.path, node.lineno,
+                    f"{what} in a hot-path data-plane module "
+                    f"materialises a host copy; make it deliberate — "
+                    f"book it in the obs.copy ledger and add "
+                    f"`# copy-ok: <reason>` — or keep the view"))
         if not isinstance(func, ast.Attribute):
             return
         if func.attr not in DECLARE_METHODS | UPDATE_METHODS:
@@ -225,7 +289,8 @@ def lint_file(path) -> List[Violation]:
         return [Violation("OBS000", str(path), e.lineno or 0,
                           f"syntax error: {e.msg}")]
     checker = _Checker(str(path), source,
-                       profile_exempt=_profile_exempt(path))
+                       profile_exempt=_profile_exempt(path),
+                       copy_hot=copy_hot_path(path))
     checker.visit(tree)
     return checker.violations
 

@@ -90,6 +90,15 @@ _MC_JSON = re.compile(r"multichip (?:json|scaling): (\{.*\})")
 # profiler blocks alongside the IOPS headline
 _CL_JSON = re.compile(r"# cluster json: (\{.*\})")
 
+# zero-copy buffer-plane goal (ROADMAP item 2): r13 measured the
+# baseline at 191,329.9 copied bytes per acked op; the buffer plane
+# landed in r14 with a >=40% reduction acceptance bar.  Any run after
+# the baseline that books more than 0.6x the baseline is a red check
+# regardless of run-over-run drift — the goal is absolute.
+_COPY_BASELINE_RUN = 13
+_COPY_BASELINE = 191330.0
+_COPY_GOAL = 0.6 * _COPY_BASELINE
+
 
 def _multichip_metrics(tail: str,
                        dryrun: bool = False) -> Dict[str, float]:
@@ -416,6 +425,13 @@ def compute_deltas(rows: List[Dict],
                         f"{metric} {prev:g} -> {val:g} "
                         f"({pct * 100:+.0f}%)")
             last_seen[metric] = val
+        cbpo = row["metrics"].get("copy_bytes_per_op")
+        if cbpo is not None and row["n"] > _COPY_BASELINE_RUN \
+                and cbpo > _COPY_GOAL:
+            row["regressions"].append(
+                f"copy_bytes_per_op {cbpo:g} above the zero-copy "
+                f"goal {_COPY_GOAL:g} (0.6 x r{_COPY_BASELINE_RUN}'s "
+                f"{_COPY_BASELINE:g})")
 
 
 def render(rows: List[Dict]) -> str:
