@@ -85,6 +85,19 @@ FAILPOINTS: Dict[str, str] = {
     "mon.drop_pg_stats": "monitor drops an incoming pg_stats beacon",
     "mon.isolate_rank": "monitor drops all mon-to-mon traffic "
                         "(rank isolation / partition)",
+    # network partitions (directional, daemon-pair scoped): the
+    # receiving messenger swallows any typed frame whose sender->
+    # receiver pair matches an armed `pairs` extra — no handler, no
+    # reply, no ack, exactly the silence a cut link leaves.  The
+    # extra is `pairs:<src>><dst>|<src>><dst>...` with name-prefix
+    # matching per side and `*` (or empty) as a wildcard; listing
+    # only one direction gives an ASYMMETRIC (one-way) cut, e.g.
+    # `net.partition=p:1.0,pairs:osd.3>mon|mon>osd.3` (symmetric
+    # mon<->osd.3 split) vs `...,pairs:mon.0>mon.2|mon.1>mon.2`
+    # (one-way: rank 2 deaf to its peers, its own sends still land)
+    "net.partition": "directional traffic drop between scoped "
+                     "daemon pairs (pairs:<src>><dst>|..., prefix "
+                     "match, '*' wildcard; asymmetric supported)",
     # manager faults
     "mgr.balancer.stale_map": "balancer sweep evaluated a stale "
                               "OSDMap; the round's proposals are "
@@ -287,6 +300,47 @@ def fires(name: str, who: Optional[str] = None) -> bool:
         fp.fired += 1
         _fired_total[name] = _fired_total.get(name, 0) + 1
     _counters().inc(name)
+    return True
+
+
+def _side_match(name: str, pat: str) -> bool:
+    return pat in ("", "*") or name.startswith(pat)
+
+
+def partitioned(src: Optional[str], dst: Optional[str]) -> bool:
+    """Directional ``net.partition`` check: should traffic from
+    daemon ``src`` to daemon ``dst`` be dropped?  Consulted by the
+    receiving messenger per typed frame (the sender's name rides
+    every call/send frame as ``frm``).  One bool test when nothing
+    is armed, like :func:`fires`."""
+    global _ACTIVE
+    if not _ACTIVE or not src or not dst:
+        return False
+    with _lock:
+        fp = _armed.get("net.partition")
+        if fp is None:
+            return False
+        for pair in fp.extras.get("pairs", "").split("|"):
+            s, sep, d = pair.partition(">")
+            if sep and _side_match(src, s.strip()) and \
+                    _side_match(dst, d.strip()):
+                break
+        else:
+            return False
+        if fp.mode == "p":
+            if _rng.random() >= fp.p:
+                return False
+        else:  # count / oneshot
+            if fp.remaining <= 0:
+                return False
+            fp.remaining -= 1
+            if fp.remaining <= 0:
+                del _armed["net.partition"]
+                _ACTIVE = bool(_armed)
+        fp.fired += 1
+        _fired_total["net.partition"] = \
+            _fired_total.get("net.partition", 0) + 1
+    _counters().inc("net.partition")
     return True
 
 

@@ -60,6 +60,31 @@ OPTIONS: Dict[str, Option] = _opts(
     Option("mon_osd_down_out_interval", float, 5.0,
            "seconds down before an osd is marked out (weight 0), "
            "triggering remap + backfill"),
+    Option("mon_osd_report_timeout", float, 0.0,
+           "seconds without a DIRECT osd->mon beacon before the "
+           "monitor marks an osd down on its own (the liveness-of-"
+           "last-resort path; peer failure reports are the primary "
+           "detector); 0 = auto (5x osd_heartbeat_grace)"),
+    Option("mon_osd_min_down_reporters", int, 2,
+           "peer failure reports from this many distinct CRUSH "
+           "failure-domain subtrees before the monitor marks an osd "
+           "down (OSDMonitor::check_failure role)"),
+    Option("mon_osd_reporter_subtree_level", str, "host",
+           "CRUSH bucket type at which failure reporters are "
+           "deduplicated: reports from osds under the same subtree "
+           "of this type count as ONE reporter"),
+    Option("osd_heartbeat_min_peers", int, 4,
+           "pad the PG-derived heartbeat peer set with other up osds "
+           "until it reaches this size, so sparse PG overlap (small "
+           "pools, pool-less clusters) still yields enough failure "
+           "reporters for the monitor's quorum"),
+    Option("osd_max_markdown_count", int, 5,
+           "markdowns within osd_max_markdown_period before the osd "
+           "is dampened: re-boots deferred + auto-out, surfaced as "
+           "the OSD_FLAPPING health check (osd_markdown_log role)"),
+    Option("osd_max_markdown_period", float, 600.0,
+           "sliding window (seconds) for osd_max_markdown_count; "
+           "dampening clears once the window empties"),
     Option("osd_max_backfills", int, 1,
            "concurrent recovery streams per osd"),
     Option("osd_calc_pg_upmaps_aggressively", bool, True,

@@ -37,6 +37,8 @@ REGISTRY: Dict[str, Dict[str, str]] = {
         "epochs": U64,
         "beats": U64,
         "markdowns": U64,
+        "failure_reports": U64,
+        "markdowns_dampened": U64,
         "commit_lat": HIST,
         "commit_time": TIME,
         "pg_stat_reports": U64,
@@ -123,8 +125,21 @@ REGISTRY: Dict[str, Dict[str, str]] = {
         "osd.shard_read_eio": U64,
         "mon.drop_pg_stats": U64,
         "mon.isolate_rank": U64,
+        "net.partition": U64,
         "mgr.balancer.stale_map": U64,
         "store.bit_rot": U64,
+    },
+    # the peer-heartbeat plane (services/heartbeat.py, the
+    # OSD::heartbeat role): ping/ack volume, failure reports sent to
+    # the mon, the live peer-set gauge, and ping RTT (whose windowed
+    # average is the daemonperf `hb lat` column)
+    "osd.hb": {
+        "pings": U64,
+        "acks": U64,
+        "failures_reported": U64,
+        "peers": GAUGE,
+        "ping_time": TIME,
+        "ping_lat": HIST,
     },
     # the recovery engine (osd_service._run_recovery): pipeline shape,
     # helper-read fan-out and exclusion accounting, reservation

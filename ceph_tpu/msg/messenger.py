@@ -1030,6 +1030,14 @@ class Messenger:
                 return
             throttle.get(nbytes)
         try:
+            if faults._ACTIVE and faults.partitioned(
+                    str(msg.get("frm") or ""), self.name):
+                # a directional net.partition covers this sender->
+                # receiver pair: the frame never "arrived" — no
+                # handler, no reply, no ack; the sender sees the
+                # same silence a cut link leaves (its session
+                # replays on reconnect, as across a real partition)
+                return
             handler = self._handlers.get(type_)
             if handler is None:
                 reply = {"error": f"no handler for {type_!r}"}

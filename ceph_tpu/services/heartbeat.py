@@ -1,0 +1,198 @@
+"""Peer heartbeat plane — the OSD-side failure detector.
+
+The role of ``OSD::heartbeat`` / ``OSD::maybe_update_heartbeat_peers``
+(src/osd/OSD.cc:5487): every OSD pings the peers it shares PGs with
+over the messenger control lane, keeps a per-peer last-ack clock plus
+an EWMA of ping latency, and reports a peer past its (latency-adapted)
+grace to the monitors as an ``osd_failure`` — the raw material of
+``OSDMonitor::check_failure``'s reporter quorums.  The direct OSD→mon
+beacon survives only as liveness-of-last-resort with the much longer
+``mon_osd_report_timeout``, so a cut mon↔OSD link alone can no longer
+kill a healthy OSD that its peers still hear.
+
+Pings are fire-and-forget both ways (MOSDPing PING / PING_REPLY): the
+sender stamps a monotonic clock, the receiver echoes it back in its
+own fire-and-forget reply, and the sender's reply handler turns the
+echo into an RTT sample.  Nothing in the ping path ever blocks on a
+dead peer — that is the point of a failure detector.
+
+The peer set is recomputed on every map-epoch install (the
+``maybe_update_heartbeat_peers`` hook in ``_post_map_install``): for
+each PG this OSD is in the up or acting set of, every other member is
+a heartbeat peer.  The latency EWMA adapts the effective grace
+(``grace + 4×ewma``) so a loaded-but-alive peer whose scheduling
+latency grows is not storm-reported (the reference's
+``mon_osd_adjust_heartbeat_grace`` idea, done sender-side).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ..analysis.lockdep import make_lock
+
+# EWMA smoothing for ping RTT and its weight in the effective grace:
+# eff_grace = grace + GRACE_LAT_FACTOR * ewma.  On a loopback cluster
+# ewma is sub-millisecond and the bound stays ~grace; under full-suite
+# CPU load the inflated RTTs buy loaded peers headroom automatically.
+EWMA_ALPHA = 0.3
+GRACE_LAT_FACTOR = 4.0
+
+
+class _Peer:
+    """Per-peer clock state (one heartbeat_info_t)."""
+
+    __slots__ = ("last_ack", "ewma")
+
+    def __init__(self, now: float):
+        # a fresh peer gets a full grace window from discovery — it
+        # has never been asked, so it cannot already be overdue
+        self.last_ack = now
+        self.ewma = 0.0
+
+
+class HeartbeatPlane:
+    """One OSD's peer-ping plane.  Owned by OSDService: constructed
+    with it (registers its two control-lane handlers), started after
+    the first map install, peers recomputed per epoch."""
+
+    def __init__(self, svc) -> None:
+        self.svc = svc
+        self.log = svc.log
+        conf = svc.ctx.conf
+        self.interval: float = conf["osd_heartbeat_interval"]
+        self.grace: float = conf["osd_heartbeat_grace"]
+        self._lock = make_lock("osd::hb")
+        self._peers: Dict[int, _Peer] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        pc = self.pc = svc.ctx.perf.create(f"osd.hb.{svc.id}")
+        for key in ("pings", "acks", "failures_reported"):
+            pc.add_u64_counter(key)
+        pc.add_u64("peers")
+        pc.add_time("ping_time")
+        pc.add_histogram("ping_lat")
+        svc.msgr.register("osd_ping", self._h_ping, control=True)
+        svc.msgr.register("osd_ping_reply", self._h_ping_reply,
+                          control=True)
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"osd{self.svc.id}-hb")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- peer selection (maybe_update_heartbeat_peers) -----------------
+    def update_peers(self) -> None:
+        """Recompute the peer set from the installed map: every other
+        member of every PG this OSD is in the up or acting set of."""
+        svc = self.svc
+        with svc._lock:
+            m = svc.map
+        if m is None:
+            return
+        me = svc.id
+        want = set()
+        for pool_id, pool in list(m.pools.items()):
+            for ps in range(pool.pg_num):
+                up, _p, acting, _ap = svc.pg_up_acting(pool_id, ps)
+                # >= 0 drops CRUSH_ITEM_NONE placeholders (EC pools
+                # keep positional holes for unmapped shards)
+                members = {o for o in set(up) | set(acting) if o >= 0}
+                if me in members:
+                    want |= members - {me}
+        # pad sparse PG overlap (small pools, pool-less clusters) with
+        # other up osds — the osd_heartbeat_min_peers role — walking
+        # ids cyclically FROM our own so padding coverage spreads
+        # instead of piling onto the lowest ids
+        min_peers = svc.ctx.conf["osd_heartbeat_min_peers"]
+        if len(want) < min_peers:
+            others = sorted(
+                (o for o in range(m.max_osd)
+                 if o != me and o not in want and m.exists(o)
+                 and m.is_up(o)),
+                key=lambda o: (o - me) % max(m.max_osd, 1))
+            want.update(others[:min_peers - len(want)])
+        now = time.monotonic()
+        with self._lock:
+            for osd in list(self._peers):
+                if osd not in want:
+                    del self._peers[osd]
+            for osd in want:
+                if osd not in self._peers:
+                    self._peers[osd] = _Peer(now)
+            self.pc.set("peers", len(self._peers))
+
+    # -- the ping loop -------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self._tick()
+            except Exception as e:
+                self.log.derr(f"osd.{self.svc.id} hb tick: {e!r}")
+
+    def _tick(self) -> None:
+        svc = self.svc
+        now = time.monotonic()
+        with self._lock:
+            peers = {o: (p.last_ack, p.ewma)
+                     for o, p in self._peers.items()}
+        with svc._lock:
+            m = svc.map
+            addrs = dict(svc.osd_addrs)
+        overdue = []
+        for osd, (last_ack, ewma) in peers.items():
+            addr = addrs.get(osd)
+            if addr is None:
+                continue  # can't ping -> no basis to condemn; the
+                # mon's beacon timeout owns an osd we can't even dial
+            svc.msgr.send(tuple(addr), {
+                "type": "osd_ping", "osd": svc.id,
+                "addr": list(svc.addr), "stamp": now})
+            self.pc.inc("pings")
+            eff_grace = self.grace + GRACE_LAT_FACTOR * ewma
+            if now - last_ack > eff_grace and m is not None and \
+                    m.is_up(osd):
+                overdue.append((osd, now - last_ack))
+        for osd, failed_for in overdue:
+            # re-sent every interval while the peer stays silent and
+            # up in our map: the monitor's reports DECAY, so a live
+            # claim must keep refreshing until check_failure acts
+            svc.mon_send({"type": "osd_failure", "osd": osd,
+                          "frm_osd": svc.id,
+                          "failed_for": round(failed_for, 3)})
+            self.pc.inc("failures_reported")
+
+    # -- handlers (both fire-and-forget, control lane) -----------------
+    def _h_ping(self, msg: Dict) -> None:
+        # echo the stamp back to the pinger's listening address; our
+        # own send is fire-and-forget too, so a half-dead link drops
+        # the reply instead of wedging this handler
+        addr = msg.get("addr")
+        if addr:
+            self.svc.msgr.send(tuple(addr), {
+                "type": "osd_ping_reply", "osd": self.svc.id,
+                "stamp": msg.get("stamp", 0.0)})
+        return None
+
+    def _h_ping_reply(self, msg: Dict) -> None:
+        now = time.monotonic()
+        rtt = max(0.0, now - float(msg.get("stamp", now)))
+        osd = int(msg["osd"])
+        with self._lock:
+            peer = self._peers.get(osd)
+            if peer is None:
+                return None
+            peer.last_ack = now
+            peer.ewma = rtt if peer.ewma == 0.0 else (
+                EWMA_ALPHA * rtt + (1.0 - EWMA_ALPHA) * peer.ewma)
+        self.pc.inc("acks")
+        self.pc.tinc("ping_time", rtt)
+        self.pc.hist_add("ping_lat", rtt)
+        return None

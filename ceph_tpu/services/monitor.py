@@ -81,6 +81,25 @@ class Monitor:
         self._osd_addrs: Dict[int, Addr] = {}
         self._last_beat: Dict[int, float] = {}
         self._down_since: Dict[int, float] = {}
+        # OSDMonitor::check_failure state: failed osd -> {reporter
+        # osd: mono stamp of its latest osd_failure report}.  Reports
+        # DECAY (reporters re-send every heartbeat interval while the
+        # peer stays silent), so a burst from one partitioned corner
+        # of the cluster cannot linger forever as half a quorum.
+        self._failure_reports: Dict[int, Dict[int, float]] = {}
+        # osd -> mono stamp of its last accepted boot: a failure
+        # report whose silence window STARTED before the boot is
+        # evidence against the previous incarnation, not this one
+        # (check_failure's failed_since >= up_from rule)
+        self._up_from: Dict[int, float] = {}
+        # the osd_markdown_log role: osd -> markdown stamps within
+        # osd_max_markdown_period; crossing osd_max_markdown_count
+        # dampens the daemon (boot deferred + auto-out) and raises
+        # the OSD_FLAPPING health check
+        self._markdown_log: Dict[int, Deque[float]] = {}
+        # osd -> last time we pushed the map at a beating-but-down
+        # daemon (rate limit for the wrongly-marked-down nudge)
+        self._down_nudge: Dict[int, float] = {}
         # osd -> pre-out weight, for osds the MONITOR outed (auto-out);
         # restored on boot, unlike an admin mark_out which sticks
         self._auto_out: Dict[int, int] = {}
@@ -98,6 +117,8 @@ class Monitor:
         self.pc.add_u64_counter("epochs")
         self.pc.add_u64_counter("beats")
         self.pc.add_u64_counter("markdowns")
+        self.pc.add_u64_counter("failure_reports")
+        self.pc.add_u64_counter("markdowns_dampened")
         self.pc.add_u64_counter("pg_stat_reports")
         self.pc.add_u64("stale_pgs")
         self.pc.add_histogram("commit_lat")
@@ -115,6 +136,9 @@ class Monitor:
                           ("heartbeat", self._fwd(self._h_heartbeat,
                                                   fire_forget=True),
                            True),
+                          ("osd_failure",
+                           self._fwd(self._h_osd_failure,
+                                     fire_forget=True), True),
                           ("get_map", self._h_get_map, True),
                           ("get_inc", self._h_get_inc, True),
                           ("subscribe", self._h_subscribe, False),
@@ -170,6 +194,10 @@ class Monitor:
         """Join an N-monitor quorum (call before start()).  ``addrs``
         is the rank-ordered list of every member including self."""
         self.rank = rank
+        # rank-qualified wire identity: every frame's ``frm`` carries
+        # it, so the net.partition fault plane can scope a single
+        # rank ("mon.2") while "mon" still prefix-matches them all
+        self.msgr.name = f"mon.{rank}"
         self.quorum = Quorum(
             self, rank, addrs,
             lease=self.ctx.conf["mon_lease"],
@@ -500,9 +528,24 @@ class Monitor:
         osd = int(msg["osd"])
         addr = tuple(msg["addr"])
         with self._lock:
+            now = time.monotonic()
+            if self.map.exists(osd) and not self.map.is_up(osd) \
+                    and self._is_dampened(osd, now):
+                # osd_markdown_log dampening: a daemon that flapped
+                # through the markdown budget stays down until its
+                # oldest markdown ages out of the window (the delayed
+                # re-boot role); it keeps re-beating boot and gets in
+                # once the log drains
+                self._last_beat[osd] = now  # alive, just dampened
+                return {"epoch": self.map.epoch, "dampened": True}
             addr_changed = self._osd_addrs.get(osd) != addr
             self._osd_addrs[osd] = addr
-            self._last_beat[osd] = time.monotonic()
+            self._last_beat[osd] = now
+            # a booting incarnation starts with a clean slate: stale
+            # peer reports against the previous incarnation must not
+            # insta-kill it (the markdown/boot oscillation guard)
+            self._failure_reports.pop(osd, None)
+            self._up_from[osd] = now
             was_up = self.map.exists(osd) and self.map.is_up(osd)
             # weight policy on boot (OSDMonitor::prepare_boot): an osd
             # the monitor auto-outed comes back in; an osd an admin
@@ -528,8 +571,29 @@ class Monitor:
         return {"epoch": self.map.epoch}
 
     def _h_heartbeat(self, msg: Dict) -> None:
+        osd = int(msg["osd"])
+        push = None
         with self._lock:
-            self._last_beat[int(msg["osd"])] = time.monotonic()
+            now = time.monotonic()
+            self._last_beat[osd] = now
+            if self.map.exists(osd) and not self.map.is_up(osd) \
+                    and self._committed_epoch \
+                    and now - self._down_nudge.get(osd, 0.0) > 1.0:
+                pusher = self._pushers.get(f"osd.{osd}")
+                if pusher is not None:
+                    self._down_nudge[osd] = now
+                    payload = decode_epoch_payload(
+                        self._epochs[self._committed_epoch])
+                    push = (pusher, payload)
+        if push is not None:
+            # a beat from an osd the map says is DOWN: the daemon is
+            # alive but missed its own markdown epoch (a healed
+            # partition dropped the push without replay) — shove the
+            # committed map at it so it can see itself down, request
+            # a re-boot, and rejoin without waiting for an unrelated
+            # commit to come along
+            push[0].push({"type": "map_update",
+                          "payload": self._wire_full(push[1])})
         self.pc.inc("beats")
         return None
 
@@ -912,6 +976,10 @@ class Monitor:
             down = [o for o in range(self.map.max_osd)
                     if self.map.exists(o) and not self.map.is_up(o)
                     and self.map.osd_weight[o] > 0]
+            # sorted() snapshots the keys: _is_dampened prunes (and
+            # may delete) log entries while we iterate
+            flapping = [o for o in sorted(self._markdown_log)
+                        if self._is_dampened(o, now)]
             pgs = self._pg_summary()
             stale = [pgid for pgid, st in self._pg_stats.items()
                      if now - st.get("last_report", now) > grace]
@@ -927,6 +995,12 @@ class Monitor:
         checks = []
         if down:
             checks.append(f"OSD_DOWN: {len(down)} osds down: {down}")
+        if flapping:
+            # dampened daemons are auto-outed (not counted by
+            # OSD_DOWN's weight>0 scope), so flapping gets its own
+            # coded check and clears when the markdown log drains
+            checks.append(f"OSD_FLAPPING: {len(flapping)} osd(s) "
+                          f"flapping (markdown-dampened): {flapping}")
         if pgs["degraded_pgs"] or recovering:
             # an OPEN recovery event counts: a fast recovery's
             # degraded beacons may be superseded between two health
@@ -977,6 +1051,88 @@ class Monitor:
                     "subscribers": sorted(self._subscribers)}
 
     # -- failure detection ------------------------------------------------
+    def _reporter_subtree(self, osd: int) -> int:
+        """CRUSH node id of the reporter's failure-domain subtree at
+        ``mon_osd_reporter_subtree_level`` (check_failure's reporter
+        dedup: two osds on one host are ONE witness).  An osd not
+        placed in the crush tree is its own subtree."""
+        from ..crush.wrapper import DEFAULT_TYPES
+
+        level = self.ctx.conf["mon_osd_reporter_subtree_level"]
+        want = next((t for t, n in DEFAULT_TYPES.items()
+                     if n == level), 1)
+        node, hops = osd, 0
+        while hops < 16:  # cycle guard; real trees are depth ~4
+            hops += 1
+            b = next((b for b in self.map.crush.buckets.values()
+                      if node in b.items), None)
+            if b is None:
+                return node
+            if b.type >= want:
+                return b.id
+            node = b.id
+        return node
+
+    def _h_osd_failure(self, msg: Dict) -> None:
+        """OSDMonitor::check_failure — a peer's osd_failure report.
+        Mark down only once reports arrive from enough DISTINCT
+        failure-domain subtrees: a cut link to one host (or to this
+        monitor) can no longer kill a healthy osd on its own."""
+        failed = int(msg["osd"])
+        reporter = int(msg["frm_osd"])
+        self.pc.inc("failure_reports")
+        grace = self.ctx.conf["osd_heartbeat_grace"]
+        need = self.ctx.conf["mon_osd_min_down_reporters"]
+        now = time.monotonic()
+        with self._lock:
+            if failed == reporter or not self.map.exists(failed):
+                return None
+            if not self.map.is_up(failed):
+                # already down: late reports are stale, not evidence
+                # against the NEXT incarnation
+                self._failure_reports.pop(failed, None)
+                return None
+            failed_for = float(msg.get("failed_for", 0.0))
+            if now - failed_for < self._up_from.get(failed, 0.0):
+                # the reporter's silence window opened before this
+                # incarnation booted: stale evidence (the
+                # failed_since >= up_from rule) — without it a cut
+                # link would re-kill a re-booting osd every beat
+                # instead of after a fresh full grace
+                return None
+            reps = self._failure_reports.setdefault(failed, {})
+            reps[reporter] = now
+            for r, ts in list(reps.items()):
+                if now - ts > 2 * grace:  # report decay
+                    del reps[r]
+            subtrees = {self._reporter_subtree(r) for r in reps}
+            enough = len(subtrees) >= need
+            reporters = sorted(reps)
+        if enough:
+            self.log.dout(
+                1, f"osd.{failed} failed by {len(subtrees)} "
+                   f"subtree(s), reporters {reporters}")
+            try:
+                self.mark_down(failed)
+            except RuntimeError as e:
+                self.log.derr(f"failure markdown aborted: {e}")
+        return None
+
+    def _is_dampened(self, osd: int, now: float) -> bool:
+        """True while the osd's markdown log crosses
+        ``osd_max_markdown_count`` within ``osd_max_markdown_period``
+        (caller holds the lock).  Prunes the log as a side effect."""
+        log = self._markdown_log.get(osd)
+        if not log:
+            return False
+        period = self.ctx.conf["osd_max_markdown_period"]
+        while log and now - log[0] > period:
+            log.popleft()
+        if not log:
+            del self._markdown_log[osd]
+            return False
+        return len(log) >= self.ctx.conf["osd_max_markdown_count"]
+
     def mark_down(self, osd: int) -> int:
         from ..osdmap.osdmap import OSD_EXISTS
 
@@ -986,14 +1142,41 @@ class Monitor:
             self.map.osd_state[osd] = OSD_EXISTS  # up bit cleared
             self._last_beat.pop(osd, None)
             self._down_since[osd] = time.monotonic()
+            # consumed: the reports did their job; a fresh incarnation
+            # must be condemned by fresh evidence, not leftovers
+            self._failure_reports.pop(osd, None)
+            now = time.monotonic()
+            mdl = self._markdown_log.setdefault(
+                osd, collections.deque())
+            mdl.append(now)
+            dampened = self._is_dampened(osd, now)
+            if dampened and self.map.osd_weight[osd] > 0:
+                # flapping: don't wait out mon_osd_down_out_interval —
+                # remap around the unstable daemon NOW (auto-out, so
+                # a stable re-boot restores the weight)
+                self._auto_out[osd] = self.map.osd_weight[osd]
+                self.map.osd_weight[osd] = 0
+                self._down_since.pop(osd, None)
         self.pc.inc("markdowns")
-        self.log.dout(1, f"osd.{osd} marked down")
+        if dampened:
+            self.pc.inc("markdowns_dampened")
+            self.log.dout(1, f"osd.{osd} marked down (flapping: "
+                             f"dampened + auto-out)")
+        else:
+            self.log.dout(1, f"osd.{osd} marked down")
         return self._commit(f"osd.{osd} down")
 
     def _tick_loop(self) -> None:
         grace = self.ctx.conf["osd_heartbeat_grace"]
         interval = self.ctx.conf["osd_heartbeat_interval"]
         out_interval = self.ctx.conf["mon_osd_down_out_interval"]
+        # the direct osd->mon beacon is liveness-of-last-resort only:
+        # peer osd_failure reports (check_failure) are the primary
+        # detector, so a beacon gap alone — a cut mon link, a loaded
+        # beat thread — gets a MUCH longer rope before the monitor
+        # acts unilaterally (the mon_osd_report_timeout role)
+        report_timeout = self.ctx.conf["mon_osd_report_timeout"] \
+            or 5 * grace
         while self._running:
             time.sleep(interval / 2)  # fault-ok: failure-detection
             # tick cadence, not retry pacing against a failing peer
@@ -1010,7 +1193,8 @@ class Monitor:
             to_out = []
             with self._lock:
                 for osd, last in self._last_beat.items():
-                    if now - last > grace and self.map.is_up(osd):
+                    if now - last > report_timeout and \
+                            self.map.is_up(osd):
                         stale.append(osd)
                 # down -> out after the grace window: clearing the
                 # in/out weight is what makes CRUSH remap the osd's

@@ -98,6 +98,10 @@ class OSDService(MapFollower):
         self._beat_thread: Optional[threading.Thread] = None
         self._recover_thread: Optional[threading.Thread] = None
         self._recover_wake = threading.Event()
+        # set by shutdown(): the beat loop waits on THIS between
+        # beacons (not a fixed sleep), so teardown never stalls a
+        # full heartbeat interval behind a sleeping thread
+        self._shutdown_ev = threading.Event()
         self.backfill_throttle = Throttle(
             "backfill", ctx.conf["osd_max_backfills"])
         # per-PG serialization: RMW coordination AND the local
@@ -201,6 +205,13 @@ class OSDService(MapFollower):
                      ("status", self._h_status)):
             self.msgr.register(t, h, control=t in control)
 
+        # the peer failure detector (OSD::heartbeat role): registers
+        # its osd_ping/osd_ping_reply control-lane handlers here;
+        # started with the daemon, peers recomputed per map install
+        from .heartbeat import HeartbeatPlane
+
+        self.hb = HeartbeatPlane(self)
+
     # -- persistence (superblock/restart-replay role) -------------------
     def _mount(self):
         """Without a data_dir the OSD is a pure in-RAM daemon
@@ -254,9 +265,13 @@ class OSDService(MapFollower):
             target=self._recover_loop, daemon=True,
             name=f"osd{self.id}-recover")
         self._recover_thread.start()
+        self.hb.update_peers()
+        self.hb.start()
 
     def shutdown(self) -> None:
         self._running = False
+        self._shutdown_ev.set()
+        self.hb.stop()
         self._recover_wake.set()
         pool = getattr(self, "_fanout_pool", None)
         if pool is not None:
@@ -286,6 +301,7 @@ class OSDService(MapFollower):
                              f"{epoch}; re-booting to mon")
             self.mon_send({"type": "boot", "osd": self.id,
                            "addr": list(self.addr)})
+        self.hb.update_peers()
         self._recover_wake.set()
 
     def _h_map_update(self, msg: Dict) -> None:
@@ -1303,6 +1319,16 @@ class OSDService(MapFollower):
             # mon_send reaches every quorum member: peons forward to
             # the leader, so liveness survives any single monitor death
             self.mon_send({"type": "heartbeat", "osd": self.id})
+            # a monitor that deferred our boot (markdown dampening) or
+            # marked us down while our re-boot raced a commit leaves
+            # the map showing us down with no new epoch to react to:
+            # keep re-booting at beacon cadence until the map agrees
+            with self._lock:
+                down = self.map is not None \
+                    and not self.map.is_up(self.id)
+            if down:
+                self.mon_send({"type": "boot", "osd": self.id,
+                               "addr": list(self.addr)})
             # the continuous-stats cadence rides the beat thread: PG
             # io/recovery counters reach the monitors between peering
             # passes, so pool rates resolve at beacon granularity
@@ -1313,8 +1339,11 @@ class OSDService(MapFollower):
                     self._stat_beacon_pass()
                 except Exception as e:
                     self.log.dout(5, f"stat beacon pass failed: {e}")
-            time.sleep(interval)  # fault-ok: heartbeat cadence, not
-            # retry pacing against a failing peer
+            # waits on the shutdown event rather than sleeping: a
+            # teardown mid-interval returns immediately instead of
+            # holding shutdown() hostage for up to a full beat
+            if self._shutdown_ev.wait(interval):
+                return
 
     # -- recovery (mark-down -> remap -> recover) ----------------------
     def _recover_loop(self) -> None:

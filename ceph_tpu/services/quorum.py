@@ -316,6 +316,7 @@ class Quorum:
         infos = [{"rank": self.rank,
                   "last_committed": self.mon.last_committed()}]
         uncommitted = []
+        peer_epoch = 0
         with self._lock:
             self._persist_locked()  # durable promise for our own round
             if self.uncommitted is not None:
@@ -328,6 +329,7 @@ class Quorum:
                     timeout=self.call_timeout)
             except (OSError, TimeoutError):
                 continue
+            peer_epoch = max(peer_epoch, int(rep.get("epoch", 0)))
             if rep.get("ack"):
                 acks += 1
                 infos.append({"rank": r,
@@ -339,7 +341,21 @@ class Quorum:
             if self.election_epoch != e or self.state != ELECTING:
                 return  # a newer round superseded this one
             if acks < self.majority:
-                return  # retry at the staggered deadline
+                if peer_epoch >= e:
+                    # reachable peers nacked at a round at least as
+                    # new as ours: an asymmetrically cut candidate
+                    # (its proposes arrive, the replies home but the
+                    # leader's leases never do) would otherwise
+                    # re-propose forever, deposing the live leader on
+                    # every retry.  Adopt the standing epoch and drop
+                    # to PROBING — the probe rejoins the standing
+                    # quorum as a peon WITHOUT another epoch bump.
+                    if peer_epoch > e:
+                        self.promised_rank = None
+                    self.election_epoch = peer_epoch
+                    self.state = PROBING
+                    self._persist_locked()
+                return  # retry (or probe) at the staggered deadline
         # the ack majority IS the collect majority: every ack carried
         # last_committed + any staged entry, so the intersection
         # argument holds without a second best-effort round

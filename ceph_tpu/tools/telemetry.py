@@ -219,6 +219,21 @@ def _column_value(perf: Dict, logger_glob: str, key: str) -> float:
     return total
 
 
+def _time_value(perf: Dict, logger_glob: str, key: str,
+                sub: str) -> float:
+    """Sum one field of a TIME counter's {avgcount, sum} dump across
+    matching loggers (time counters dump as dicts, which
+    _column_value deliberately skips)."""
+    total = 0.0
+    for logger, counters in (perf or {}).items():
+        if not fnmatch.fnmatch(logger, logger_glob):
+            continue
+        val = (counters or {}).get(key)
+        if isinstance(val, dict):
+            total += float(val.get(sub, 0) or 0)
+    return total
+
+
 # op-throughput counters the derived cp/op column divides by —
 # every client/OSD op the byte-copy ledger can book against
 _OP_COUNTERS: List[Tuple[str, str]] = [
@@ -262,16 +277,18 @@ def daemonperf_view(prev: Dict, cur: Dict,
     (logger glob, key), values are deltas/second between the two
     snapshots.
 
-    ``derived`` appends two computed columns sourced from the PR-13
-    observability families: ``cp/op`` (delta obs.copy bytes_copied /
-    delta ops — host bytes copied per op) and ``unattr%`` (the
-    unattributed critical-path share of the daemon's completed traces
-    in the current snapshot)."""
+    ``derived`` appends three computed columns: ``cp/op`` (delta
+    obs.copy bytes_copied / delta ops — host bytes copied per op) and
+    ``unattr%`` (the unattributed critical-path share of the daemon's
+    completed traces) from the PR-13 observability families, plus
+    ``hb lat`` — the mean peer ping RTT in ms over the window (delta
+    osd.hb ping_time sum / delta acks), the live view of the failure
+    detector's latency EWMA input."""
     columns = columns or DEFAULT_COLUMNS
     dt = max(1e-9, cur.get("ts", 0) - prev.get("ts", 0))
     headers = [h for _g, _k, h in columns]
     if derived:
-        headers = headers + ["cp/op", "unattr%"]
+        headers = headers + ["cp/op", "unattr%", "hb lat"]
     width = max(8, *(len(h) + 1 for h in headers))
     name_w = max([len("daemon")] +
                  [len(d) for d in cur.get("daemons", {})]) + 1
@@ -299,6 +316,14 @@ def daemonperf_view(prev: Dict, cur: Dict,
                           else "-").rjust(width))
             cells.append((f"{unattr[daemon]:.1%}"
                           if daemon in unattr else "-").rjust(width))
+            d_rtt = (_time_value(cperf, "osd.hb.*", "ping_time",
+                                 "sum")
+                     - _time_value(pperf, "osd.hb.*", "ping_time",
+                                   "sum"))
+            d_acks = (_column_value(cperf, "osd.hb.*", "acks")
+                      - _column_value(pperf, "osd.hb.*", "acks"))
+            cells.append((f"{d_rtt / d_acks * 1000:.1f}"
+                          if d_acks > 0 else "-").rjust(width))
         lines.append(daemon.ljust(name_w) + "".join(cells))
     return "\n".join(lines)
 

@@ -1,0 +1,235 @@
+"""Partition-tolerant failure detection (PR 15).
+
+The detector's whole contract, end to end:
+
+- ``faults.partitioned`` pair matching is directional (asymmetric cuts
+  are first-class) with prefix scoping and wildcards;
+- a healthy OSD that loses only its mon link is NOT marked down — its
+  peers still hear it and the direct beacon is last-resort only
+  (the false-markdown scenario the beacon-only detector failed);
+- a truly isolated OSD IS marked down, by reporter quorum, within the
+  heartbeat grace, and re-boots itself once it learns the markdown;
+- ``check_failure`` dedups reporters by CRUSH failure-domain subtree:
+  reports from one host are ONE witness, not a quorum;
+- the ``osd_markdown_log`` dampener: a flapping daemon crosses its
+  markdown budget, gets auto-outed with boots deferred, raises
+  OSD_FLAPPING, and rejoins once the log drains;
+- markdown/out racing re-boots never oscillates the map faster than
+  one grace window (the satellite-4 monotone-epoch story).
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.analysis import faults
+from ceph_tpu.common.config import Config
+from ceph_tpu.services.cluster import MiniCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _conf():
+    c = Config()
+    c.set("osd_heartbeat_interval", 0.2)
+    c.set("osd_heartbeat_grace", 0.8)
+    # peer reports do the detecting; the beacon timeout must never
+    # fire inside a test's partition window
+    c.set("mon_osd_report_timeout", 30.0)
+    c.set("mon_osd_down_out_interval", 30.0)
+    return c
+
+
+# -- faults.partitioned unit surface ----------------------------------
+
+def test_partitioned_is_directional_with_wildcards():
+    faults.arm("net.partition", "p", p=1.0,
+               pairs="osd.1>osd.2|mon>*")
+    assert faults.partitioned("osd.1", "osd.2")
+    # asymmetric: the reverse direction flows
+    assert not faults.partitioned("osd.2", "osd.1")
+    # prefix scoping: osd.1 does not match osd.10-style names only
+    # by accident — the pair names daemons by prefix
+    assert not faults.partitioned("osd.3", "osd.2")
+    # wildcard destination
+    assert faults.partitioned("mon.0", "osd.1")
+    assert faults.partitioned("mon.2", "client.x")
+    # an unnamed sender (reply frames carry no frm) never matches:
+    # one-way cuts must not sever call replies
+    assert not faults.partitioned("", "osd.2")
+    assert not faults.partitioned(None, "osd.2")
+    faults.clear()
+    assert not faults.partitioned("osd.1", "osd.2")
+
+
+def test_partition_spec_roundtrip():
+    fps = faults.parse_spec(
+        "net.partition=p:1.0,pairs:osd.0>mon|mon>osd.0")
+    assert fps["net.partition"].extras["pairs"] == \
+        "osd.0>mon|mon>osd.0"
+
+
+# -- the detector itself ----------------------------------------------
+
+def test_mon_partition_alone_is_no_markdown():
+    """A cut mon link must not kill a healthy OSD: its peers still
+    ack its pings, so nobody reports it, and the beacon timeout is
+    far out of reach."""
+    c = MiniCluster(n_osds=3, hosts=3, config=_conf()).start()
+    try:
+        c.create_replicated_pool(1, pg_num=4, size=3)
+        cli = c.client("hb-t1")
+        cli.put(1, "k", b"v1")
+        time.sleep(1.0)  # peer clocks established
+        base_md = int(c.mon.pc.dump().get("markdowns", 0))
+        c.set_faults("net.partition=p:1.0,pairs:osd.1>mon|mon>osd.1")
+        time.sleep(2.5)  # > 3x grace with the mon link dark
+        assert 1 in c.status()["up_osds"]
+        assert int(c.mon.pc.dump().get("markdowns", 0)) == base_md
+        # client I/O through the partitioned-from-mon osd still works
+        cli.put(1, "k", b"v2")
+        assert cli.get(1, "k") == b"v2"
+        c.set_faults("")
+        c.wait_for_health_ok(timeout=20.0)
+    finally:
+        c.shutdown()
+
+
+def test_isolated_osd_marked_down_by_peers_then_rejoins():
+    """Full isolation: the peers' reports (>= 2 reporters from
+    distinct host subtrees) get the victim marked down around the
+    heartbeat grace — nowhere near the 30s beacon timeout — and the
+    still-alive victim re-boots itself once the healed link shows it
+    the markdown epoch."""
+    c = MiniCluster(n_osds=4, hosts=4, config=_conf()).start()
+    try:
+        c.create_replicated_pool(1, pg_num=4, size=3)
+        time.sleep(1.0)
+        c.set_faults("net.partition=p:1.0,pairs:osd.2>*|*>osd.2")
+        t0 = time.monotonic()
+        c.wait_for_down(2, timeout=10.0)
+        detect = time.monotonic() - t0
+        # grace 0.8 + ticks + report handling; the strict
+        # grace+2*interval gate lives in the seeded NETSPLIT drill —
+        # here we only pin "peer detection, not beacon timeout"
+        assert detect < 5.0, f"detection took {detect:.2f}s"
+        assert int(c.mon.pc.dump().get("failure_reports", 0)) > 0
+        c.set_faults("")
+        # alive + wrongly-down-in-its-own-eyes -> requests re-boot
+        c.wait_for_up(2, timeout=15.0)
+        c.wait_for_health_ok(timeout=20.0)
+    finally:
+        c.shutdown()
+
+
+def test_same_host_reporters_are_one_witness():
+    """Subtree dedup: osd.0 (host0) cut from both host1 osds.  Two
+    reporters, ONE failure-domain subtree -> no markdown; the same-host
+    peer osd.2 still hears osd.0 and never reports it."""
+    conf = _conf()
+    c = MiniCluster(n_osds=4, hosts=2, config=conf).start()
+    # host0 = {osd.0, osd.2}, host1 = {osd.1, osd.3} (d % hosts)
+    try:
+        c.create_replicated_pool(1, pg_num=4, size=2)
+        time.sleep(1.0)
+        base_md = int(c.mon.pc.dump().get("markdowns", 0))
+        c.set_faults("net.partition=p:1.0,"
+                     "pairs:osd.0>osd.1|osd.1>osd.0|"
+                     "osd.0>osd.3|osd.3>osd.0")
+        deadline = time.monotonic() + 2.5
+        while time.monotonic() < deadline:
+            assert 0 in c.status()["up_osds"], \
+                "one host's reporters must not be a quorum"
+            time.sleep(0.1)
+        # the reports DID arrive — they were deduped, not lost
+        assert int(c.mon.pc.dump().get("failure_reports", 0)) > 0
+        assert int(c.mon.pc.dump().get("markdowns", 0)) == base_md
+        c.set_faults("")
+        c.wait_for_health_ok(timeout=20.0)
+    finally:
+        c.shutdown()
+
+
+def test_flapping_osd_dampened_and_health_coded():
+    """A flapping link (peers cut, mon link open): every re-boot is
+    followed by another reporter-quorum markdown; crossing
+    osd_max_markdown_count dampens the daemon — boots deferred, the
+    osd auto-outed — and raises the OSD_FLAPPING health check; once
+    the link heals and the log drains it rejoins and health clears."""
+    conf = _conf()
+    conf.set("osd_max_markdown_count", 2)
+    conf.set("osd_max_markdown_period", 8.0)
+    c = MiniCluster(n_osds=4, hosts=4, config=conf).start()
+    try:
+        c.create_replicated_pool(1, pg_num=4, size=3)
+        time.sleep(1.0)
+        c.set_faults("net.partition=p:1.0,"
+                     "pairs:osd.3>osd.|osd.>osd.3")
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            if int(c.mon.pc.dump().get("markdowns_dampened", 0)) >= 1:
+                break
+            time.sleep(0.1)
+        dump = c.mon.pc.dump()
+        assert int(dump.get("markdowns_dampened", 0)) >= 1
+        assert int(dump.get("markdowns", 0)) >= 2
+        assert "OSD_FLAPPING" in c.health().get("check_codes", [])
+        c.set_faults("")
+        # rejoin waits for the oldest markdown to age out (delayed
+        # re-boot), then the auto-outed weight is restored on boot
+        c.wait_for_up(3, timeout=20.0)
+        c.wait_for_health_ok(timeout=30.0)
+        assert "OSD_FLAPPING" not in c.health().get("check_codes", [])
+    finally:
+        c.shutdown()
+
+
+def test_markdown_out_reboot_interplay_is_monotone():
+    """Satellite 4: down->out racing reporter-quorum markdowns and
+    concurrent re-boots must not oscillate the map inside one grace
+    window.  With peers cut and the mon link open the victim cycles
+    markdown -> nudge -> re-boot -> markdown; every cycle restarts
+    the peers' grace clocks (a booting incarnation is a FRESH peer),
+    so consecutive markdowns are at least one grace apart."""
+    conf = _conf()
+    grace = conf["osd_heartbeat_grace"]
+    # short enough that the auto-out lands INSIDE the down blip,
+    # racing the re-boot the nudged victim is about to send
+    conf.set("mon_osd_down_out_interval", 0.15)
+    conf.set("osd_max_markdown_count", 1000)  # never dampen here
+    c = MiniCluster(n_osds=4, hosts=4, config=conf).start()
+    try:
+        c.create_replicated_pool(1, pg_num=4, size=3)
+        time.sleep(1.0)
+        c.set_faults("net.partition=p:1.0,"
+                     "pairs:osd.1>osd.|osd.>osd.1")
+        samples = []  # (mono, epoch)
+        deadline = time.monotonic() + 4.5
+        while time.monotonic() < deadline:
+            st = c.status()
+            samples.append((time.monotonic(), int(st["epoch"])))
+            time.sleep(0.05)
+        # the victim's down windows are too short for a status poller
+        # (its open mon link delivers the markdown epoch immediately
+        # and it re-boots within a beat) — read the markdown stamps
+        # the dampener keeps instead
+        downs = list(c.mon._markdown_log.get(1, ()))
+        c.set_faults("")
+        # the epoch story is monotone — no commit ever rewinds it
+        epochs = [e for _t, e in samples]
+        assert epochs == sorted(epochs)
+        assert len(downs) >= 2, "expected repeated markdown cycles"
+        gaps = [b - a for a, b in zip(downs, downs[1:])]
+        assert min(gaps) >= grace * 0.9, \
+            f"markdown cycle faster than the grace window: {gaps}"
+        c.wait_for_up(1, timeout=20.0)
+        c.wait_for_health_ok(timeout=30.0)
+        # the final boot restored the auto-outed weight
+        assert c.mon.map.osd_weight[1] > 0
+    finally:
+        c.shutdown()

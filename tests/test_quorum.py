@@ -229,3 +229,58 @@ def test_quorum_with_auth_keyring(tmp_path):
             intruder.shutdown()
     finally:
         c.shutdown()
+
+
+def test_asymmetric_isolation_reelects_without_deposing():
+    """One-way isolation (satellite of PR 15): rank 2 can SEND but
+    cannot HEAR — its proposes reach the quorum while the leader's
+    leases never reach it.  The standing majority must keep serving
+    (re-electing through rank 2's blind candidacies), and once the cut
+    heals the rejoining rank must settle as a peon WITHOUT deposing
+    the leader again: its failed round's nacks carry the standing
+    election epoch, so it drops to probing and joins peacefully."""
+    from ceph_tpu.analysis import faults
+
+    conf = fast_conf()
+    c = MiniCluster(n_osds=2, hosts=2, config=conf, n_mons=3).start()
+    try:
+        c.create_replicated_pool(1, pg_num=4, size=2)
+        ldr = c.wait_for_quorum()
+        assert ldr is c.mons[0]
+        cli = c.client()
+        # inbound-only cut INTO rank 2 (replies carry no sender name,
+        # so rank 2's own calls still round-trip — true asymmetry)
+        c.set_faults("net.partition=p:1.0,"
+                     "pairs:mon.0>mon.2|mon.1>mon.2")
+        deadline = time.monotonic() + 3.0
+        i = 0
+        while time.monotonic() < deadline:
+            # the majority serves commands throughout the cut, across
+            # whatever re-elections rank 2's blind proposes force
+            cli.put(1, f"cut-{i}", b"served")
+            i += 1
+            time.sleep(0.2)
+        assert i >= 5
+        c.set_faults("")
+        faults.reset()
+        # settle: rank 2 back as a peon under the rank-0 leader
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            q = c.mons[2].quorum
+            if q.state == "peon" and q.leader_rank == 0 and \
+                    c.mons[0].quorum.is_leader():
+                break
+            time.sleep(0.1)
+        assert c.mons[2].quorum.state == "peon"
+        assert c.mons[2].quorum.leader_rank == 0
+        # the rejoined rank must NOT depose: the election epoch holds
+        # still across several lease+retry windows
+        epoch0 = c.mons[0].quorum.election_epoch
+        time.sleep(2.0)
+        assert c.mons[0].quorum.is_leader()
+        assert c.mons[0].quorum.election_epoch == epoch0
+        cli.put(1, "healed", b"stable")
+        assert cli.get(1, "healed") == b"stable"
+        assert_no_fork(c)
+    finally:
+        c.shutdown()

@@ -496,8 +496,8 @@ def test_profile_admin_verb_and_flame(ec_cluster):
 
 
 def test_daemonperf_derived_columns(ec_cluster):
-    """daemonperf satellite: the cp/op (copied bytes per served op)
-    and unattr% columns ride the derived view."""
+    """daemonperf satellite: the cp/op (copied bytes per served op),
+    unattr%, and hb lat columns ride the derived view."""
     c = ec_cluster.client("dpd")
     c.put(2, "dpd-warm", b"w" * 512)  # daemon present in BOTH snaps
     prev = telemetry.cluster_snapshot(ec_cluster.asok_dir)
@@ -506,15 +506,19 @@ def test_daemonperf_derived_columns(ec_cluster):
     time.sleep(0.05)
     cur = telemetry.cluster_snapshot(ec_cluster.asok_dir)
     view = telemetry.daemonperf_view(prev, cur)
-    header = view.splitlines()[0].split()
-    assert header[-2:] == ["cp/op", "unattr%"]
+    # "hb lat" whitespace-splits into two header tokens but one cell
+    assert view.splitlines()[0].split()[-4:] == \
+        ["cp/op", "unattr%", "hb", "lat"]
     rows = {ln.split()[0]: ln.split()
             for ln in view.splitlines()[1:]}
     # the derived columns are LAST — parse from the end: a saturated
     # rate cell earlier in the row can overflow its width and merge
     # with its neighbor, shifting index-from-header addressing
-    cp = rows["client.dpd"][-2]
+    cp = rows["client.dpd"][-3]
     assert cp != "-" and float(cp) > 0
+    # a client has no osd.hb.* loggers: its hb lat cell stays dark
+    assert rows["client.dpd"][-1] == "-"
     # derived=False restores the legacy schema
     legacy = telemetry.daemonperf_view(prev, cur, derived=False)
     assert "cp/op" not in legacy.splitlines()[0]
+    assert "hb" not in legacy.splitlines()[0].split()

@@ -24,6 +24,18 @@ THRASH_SECONDS = 12.0
 
 
 def conf():
+    # Deflake history (PR 15): the [3] variant used to flake under
+    # full-suite CPU load.  Root cause: the old beacon-only failure
+    # detector let ONE stalled mon-beat delivery (GIL contention can
+    # stretch a 0.2s cadence past the 1.2s grace) falsely mark a
+    # healthy OSD down; the 1.5s down-out then remapped PGs and the
+    # resulting recovery storm blew the verify deadlines.  The fix is
+    # structural, not a widened timeout: markdown now needs >= 2 peer
+    # REPORTERS from distinct CRUSH host subtrees (services/
+    # heartbeat.py + check_failure), the peer grace self-adapts to
+    # load via the ping-RTT EWMA, and the direct beacon survives only
+    # as liveness-of-last-resort at mon_osd_report_timeout (5x grace
+    # = 6s here) — a single slow beat can no longer kill anyone.
     c = Config()
     c.set("osd_heartbeat_interval", 0.2)
     c.set("osd_heartbeat_grace", 1.2)

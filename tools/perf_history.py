@@ -62,6 +62,9 @@ METRICS = [
     ("drill_recovery_mbs", True),
     ("drill_speedup", True),
     ("drill_p99_ms", False),
+    ("netsplit_false_markdowns", False),
+    ("netsplit_detect_s", False),
+    ("netsplit_epoch_churn", False),
     ("attr_unattr_pct", False),
     ("copy_bytes_per_op", False),
     ("prof_overhead_pct", False),
@@ -304,6 +307,38 @@ def load_drill(path: str) -> Optional[Dict]:
     return {"metrics": metrics, "fail": fail}
 
 
+def load_netsplit(path: str) -> Optional[Dict]:
+    """One NETSPLIT_rNN.json partition-drill record (tools/thrasher.py
+    --netsplit): false markdowns under a mon-link cut, true-isolation
+    detection latency, and flap-drill epoch churn become trajectory
+    metrics.  ANY false markdown, lost acked write, or failed drill
+    verdict is a regression outright — partition tolerance has no
+    acceptable drift."""
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"# {path}: unreadable ({e})", file=sys.stderr)
+        return None
+    metrics: Dict[str, float] = {}
+    if isinstance(raw.get("false_markdowns"), (int, float)):
+        metrics["netsplit_false_markdowns"] = float(
+            raw["false_markdowns"])
+    if isinstance(raw.get("detect_s"), (int, float)):
+        metrics["netsplit_detect_s"] = float(raw["detect_s"])
+    if isinstance(raw.get("epoch_churn"), (int, float)):
+        metrics["netsplit_epoch_churn"] = float(raw["epoch_churn"])
+    fail: List[str] = []
+    if raw.get("false_markdowns"):
+        fail.append(
+            f"netsplit_false_markdowns={raw['false_markdowns']}")
+    if raw.get("lost"):
+        fail.append(f"netsplit_lost_writes={raw['lost']}")
+    if raw.get("ok") is False:
+        fail.append("netsplit_drill_failed")
+    return {"metrics": metrics, "fail": fail}
+
+
 def load_all(directory: str) -> List[Dict]:
     rows = []
     for path in sorted(glob.glob(os.path.join(directory,
@@ -397,6 +432,27 @@ def load_all(directory: str) -> List[Dict]:
         for k, v in dr["metrics"].items():
             row["metrics"].setdefault(k, v)
         row["slo_fail"].extend(dr["fail"])
+    # NETSPLIT_rNN partition-drill records: detection-latency and
+    # churn metrics merge onto the same-numbered row; false markdowns
+    # and lost writes ride slo_fail into the regression check
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "NETSPLIT_r*.json"))):
+        m = re.search(r"NETSPLIT_r(\d+)\.json$", path)
+        ns = load_netsplit(path)
+        if ns is None or m is None or \
+                not (ns["metrics"] or ns["fail"]):
+            continue
+        n = int(m.group(1))
+        row = by_n.get(n)
+        if row is None:
+            row = {"run": f"r{n:02d}", "n": n,
+                   "path": os.path.basename(path), "rc": None,
+                   "platform": None, "metrics": {}, "slo_fail": []}
+            by_n[n] = row
+            rows.append(row)
+        for k, v in ns["metrics"].items():
+            row["metrics"].setdefault(k, v)
+        row["slo_fail"].extend(ns["fail"])
     rows.sort(key=lambda r: r["n"])
     return rows
 

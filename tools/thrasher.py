@@ -38,6 +38,18 @@ baseline) and the pipelined default — so the emitted
 the red-check gates (>1.5x), then a degraded-read soak races reader
 threads against active recovery with shard-read EIOs armed and gates
 the p99 against an SLO block.
+
+``--netsplit`` runs the directional network-partition drills on the
+``net.partition`` failpoint family instead: (a) a healthy OSD loses
+only its mon link — its peers still hear it, so zero false markdowns
+and uninterrupted client I/O; (b) full isolation — peer reports must
+get it marked down within ``osd_heartbeat_grace + 2x
+osd_heartbeat_interval`` with zero acked-write loss and a clean
+re-join after the heal; (c) a flapping link — repeated markdowns must
+trip the ``osd_markdown_log`` dampener, raise OSD_FLAPPING, and stop
+the epoch churn.  The ``NETSPLIT_rNN.json`` record's
+``false_markdowns`` / ``detect_s`` / ``epoch_churn`` columns are
+red-checked by tools/perf_history.py.
 """
 
 from __future__ import annotations
@@ -84,6 +96,11 @@ def _conf() -> Config:
     c.set("mon_osd_down_out_interval", 1.5)
     c.set("mon_lease", 0.3)
     c.set("mon_election_timeout", 0.5)
+    # the soak's kill/revive cadence IS flapping by design (the
+    # qa thrasher sets noout/nodown for the same reason): give the
+    # markdown log enough budget that dampening never defers the
+    # revives the verify phase depends on
+    c.set("osd_max_markdown_count", 1000)
     # the balancer rides the soak with a tight loop and deviation
     # target so its pause gate is exercised while OSDs flap
     c.set("balancer_interval", 1.0)
@@ -627,6 +644,218 @@ def drill(seed: int = 8, soak_duration: float = 8.0,
     return rec
 
 
+# -- netsplit drills (directional net.partition failpoints) -----------
+
+def _netsplit_conf() -> Config:
+    c = _conf()
+    c.set("osd_heartbeat_interval", 0.25)
+    c.set("osd_heartbeat_grace", 1.0)
+    # the whole point: peer reports detect failure; the direct mon
+    # beacon is liveness-of-last-resort only, far outside the drill
+    c.set("mon_osd_report_timeout", 30.0)
+    c.set("mon_osd_down_out_interval", 2.0)
+    c.set("mon_osd_min_down_reporters", 2)
+    return c
+
+
+def _mon_partition_phase(seed: int, n_osds: int = 4,
+                         hold_s: float = 5.0) -> Dict:
+    """(a) cut the mon<->osd link of one HEALTHY osd, both ways, for
+    ~5x grace: its peers still hear it, so the detector must record
+    ZERO false markdowns and client I/O must keep flowing.  This is
+    the exact scenario the old beacon-only detector failed (a cut mon
+    link killed a serving osd)."""
+    rng = random.Random(seed)
+    faults.reset()
+    faults.seed(seed)
+    c = MiniCluster(n_osds=n_osds, hosts=n_osds,
+                    config=_netsplit_conf()).start()
+    out: Dict = {"phase": "mon_partition", "hold_s": hold_s}
+    try:
+        c.create_replicated_pool(1, pg_num=8, size=3)
+        w = _Writer(c, 0, 1, ec=False)
+        w.start()
+        victim = rng.randrange(n_osds)
+        out["victim"] = victim
+        time.sleep(1.5)  # steady state: peers established, writes up
+        base_md = int(c.mon.pc.dump().get("markdowns", 0))
+        ops0 = w.ops
+        c.set_faults(f"net.partition=p:1.0,"
+                     f"pairs:osd.{victim}>mon|mon>osd.{victim}")
+        went_down = False
+        t_end = time.monotonic() + hold_s
+        while time.monotonic() < t_end:
+            if victim not in c.status()["up_osds"]:
+                went_down = True
+                break
+            time.sleep(0.1)  # fault-ok: drill observation cadence
+        c.set_faults("")
+        out["false_markdowns"] = int(went_down) + max(
+            0, int(c.mon.pc.dump().get("markdowns", 0)) - base_md)
+        out["ops_during_cut"] = w.ops - ops0
+        w.stop.set()
+        w.join(timeout=20)
+        bad = _verify(c, [w])
+        out["checked"] = len(w.acked)
+        out["lost"] = len(bad)
+        c.wait_for_health_ok(timeout=30)
+        out["ok"] = bool(out["false_markdowns"] == 0
+                         and out["lost"] == 0
+                         and out["ops_during_cut"] > 0)
+    finally:
+        c.shutdown()
+        faults.reset()
+    return out
+
+
+def _isolation_phase(seed: int, n_osds: int = 4) -> Dict:
+    """(b) fully isolate one osd (both directions, everyone): peers
+    must report it and the mon must mark it down within
+    osd_heartbeat_grace + 2*osd_heartbeat_interval; writes keep
+    acking on the survivors with zero acked-write loss; after the
+    heal the victim learns its markdown, re-boots, and the cluster
+    reconverges to HEALTH_OK."""
+    rng = random.Random(seed)
+    faults.reset()
+    faults.seed(seed)
+    conf = _netsplit_conf()
+    grace = conf["osd_heartbeat_grace"]
+    interval = conf["osd_heartbeat_interval"]
+    c = MiniCluster(n_osds=n_osds, hosts=n_osds, config=conf).start()
+    out: Dict = {"phase": "isolation",
+                 "detect_bound_s": round(grace + 2 * interval, 3)}
+    try:
+        c.create_replicated_pool(1, pg_num=8, size=3)
+        writers = [_Writer(c, 0, 1, ec=False),
+                   _Writer(c, 1, 1, ec=False)]
+        for w in writers:
+            w.start()
+        victim = rng.randrange(n_osds)
+        out["victim"] = victim
+        time.sleep(1.5)
+        epoch0 = int(c.status()["epoch"])
+        c.set_faults(f"net.partition=p:1.0,"
+                     f"pairs:osd.{victim}>*|*>osd.{victim}")
+        t0 = time.monotonic()
+        detect = None
+        deadline = t0 + 20.0
+        while time.monotonic() < deadline:
+            if victim not in c.status()["up_osds"]:
+                detect = time.monotonic() - t0
+                break
+            time.sleep(0.05)  # fault-ok: detection-latency poll
+        out["detect_s"] = round(detect, 3) if detect else None
+        # hold through down->out so the markdown/out interplay runs
+        # while the victim is dark, then heal: the victim's beats
+        # resume, the mon nudges it the map it missed, and it re-boots
+        time.sleep(conf["mon_osd_down_out_interval"] + 1.0)
+        c.set_faults("")
+        c.wait_for_up(victim, timeout=30)
+        for w in writers:
+            w.stop.set()
+        for w in writers:
+            w.join(timeout=20)
+        bad = _verify(c, writers)
+        out["checked"] = sum(len(w.acked) for w in writers)
+        out["lost"] = len(bad)
+        c.wait_for_health_ok(timeout=60)
+        out["epoch_churn"] = int(c.status()["epoch"]) - epoch0
+        out["ok"] = bool(detect is not None
+                         and detect <= grace + 2 * interval
+                         and out["lost"] == 0)
+    finally:
+        c.shutdown()
+        faults.reset()
+    return out
+
+
+def _flap_phase(seed: int, n_osds: int = 4,
+                hold_s: float = 8.0) -> Dict:
+    """(c) a flapping link: the victim keeps its mon link but loses
+    its peers, so every re-boot is followed by another reporter-quorum
+    markdown.  Crossing osd_max_markdown_count must dampen the daemon
+    (boot deferred + auto-out), raise OSD_FLAPPING, and STOP the epoch
+    churn; once the link heals and the markdown log drains, the osd
+    rejoins and health clears."""
+    rng = random.Random(seed)
+    faults.reset()
+    faults.seed(seed)
+    conf = _netsplit_conf()
+    conf.set("osd_max_markdown_count", 3)
+    conf.set("osd_max_markdown_period", 12.0)
+    c = MiniCluster(n_osds=n_osds, hosts=n_osds, config=conf).start()
+    out: Dict = {"phase": "flap", "hold_s": hold_s}
+    try:
+        c.create_replicated_pool(1, pg_num=8, size=3)
+        w = _Writer(c, 0, 1, ec=False)
+        w.start()
+        victim = rng.randrange(n_osds)
+        out["victim"] = victim
+        time.sleep(1.5)
+        epoch0 = int(c.status()["epoch"])
+        # peers cut both ways, mon link OPEN: markdown epochs reach
+        # the victim, it re-boots, and the flap cycle spins
+        c.set_faults(f"net.partition=p:1.0,"
+                     f"pairs:osd.{victim}>osd.|osd.>osd.{victim}")
+        time.sleep(hold_s - 3.0)
+        epoch_mid = int(c.status()["epoch"])
+        time.sleep(3.0)  # the dampened tail: churn must have stopped
+        epoch_end = int(c.status()["epoch"])
+        health = c.health()
+        dump = c.mon.pc.dump()
+        out["flapping_raised"] = "OSD_FLAPPING" in health.get(
+            "check_codes", [])
+        out["dampened"] = int(dump.get("markdowns_dampened", 0))
+        out["markdowns"] = int(dump.get("markdowns", 0))
+        out["epoch_churn"] = epoch_end - epoch0
+        out["epoch_churn_dampened_tail"] = epoch_end - epoch_mid
+        c.set_faults("")
+        # rejoin waits for the oldest markdown to age out of the
+        # window (the delayed re-boot role), then boot restores the
+        # auto-outed weight
+        c.wait_for_up(victim, timeout=30)
+        w.stop.set()
+        w.join(timeout=20)
+        bad = _verify(c, [w])
+        out["checked"] = len(w.acked)
+        out["lost"] = len(bad)
+        c.wait_for_health_ok(timeout=60)
+        out["flapping_cleared"] = "OSD_FLAPPING" not in c.health().get(
+            "check_codes", [])
+        out["ok"] = bool(out["flapping_raised"]
+                         and out["dampened"] >= 1
+                         and out["epoch_churn_dampened_tail"] <= 2
+                         and out["lost"] == 0
+                         and out["flapping_cleared"])
+    finally:
+        c.shutdown()
+        faults.reset()
+    return out
+
+
+def netsplit(seed: int = 8) -> Dict:
+    """The full NETSPLIT record: mon-link cut (no false markdowns),
+    full isolation (fast true-positive detection, zero acked loss),
+    flapping link (dampening + OSD_FLAPPING + bounded churn)."""
+    rec: Dict = {"kind": "netsplit", "seed": seed}
+    a = _mon_partition_phase(seed)
+    b = _isolation_phase(seed)
+    fl = _flap_phase(seed)
+    rec["mon_partition"] = a
+    rec["isolation"] = b
+    rec["flap"] = fl
+    # the trajectory columns perf_history red-checks
+    rec["false_markdowns"] = a.get("false_markdowns")
+    rec["detect_s"] = b.get("detect_s")
+    rec["epoch_churn"] = fl.get("epoch_churn")
+    rec["lost"] = (a.get("lost", 1) + b.get("lost", 1)
+                   + fl.get("lost", 1))
+    rec["checked"] = (a.get("checked", 0) + b.get("checked", 0)
+                      + fl.get("checked", 0))
+    rec["ok"] = bool(a.get("ok") and b.get("ok") and fl.get("ok"))
+    return rec
+
+
 def next_run_number(directory: str) -> int:
     """One past the newest committed record of ANY series (BENCH /
     MULTICHIP / CHAOS / DRILL) so the record pairs with its PR's
@@ -654,6 +883,11 @@ def main(argv=None) -> int:
                     help="run the whole-host failure drill + "
                          "degraded-read soak instead of the chaos "
                          "soak (emits DRILL_rNN.json)")
+    ap.add_argument("--netsplit", action="store_true",
+                    help="run the directional network-partition "
+                         "drills (mon-link cut, full isolation, "
+                         "flapping link) instead of the chaos soak "
+                         "(emits NETSPLIT_rNN.json)")
     ap.add_argument("--slo-p99-ms", type=float, default=250.0,
                     help="degraded-read soak p99 SLO in ms "
                          "(default 250)")
@@ -663,7 +897,8 @@ def main(argv=None) -> int:
                          "the newest committed record)")
     args = ap.parse_args(argv)
 
-    series = "DRILL" if args.host_kill else "CHAOS"
+    series = "DRILL" if args.host_kill else \
+        "NETSPLIT" if args.netsplit else "CHAOS"
     out = args.out
     if out is None:
         n = next_run_number(_ROOT)
@@ -671,6 +906,8 @@ def main(argv=None) -> int:
     m = re.search(r"_r(\d+)\.json$", out)
     if args.host_kill:
         rec = drill(seed=args.seed, slo_p99_ms=args.slo_p99_ms)
+    elif args.netsplit:
+        rec = netsplit(seed=args.seed)
     else:
         rec = soak(seed=args.seed, duration=args.duration,
                    n_osds=args.osds, n_mons=args.mons,
@@ -679,7 +916,15 @@ def main(argv=None) -> int:
     with open(out, "w") as f:
         json.dump(rec, f, indent=1)
         f.write("\n")
-    if args.host_kill:
+    if args.netsplit:
+        print(f"# netsplit seed={rec['seed']} "
+              f"false_markdowns={rec.get('false_markdowns')} "
+              f"detect={rec.get('detect_s')}s "
+              f"(bound {rec['isolation'].get('detect_bound_s')}s) "
+              f"churn={rec.get('epoch_churn')} "
+              f"lost={rec.get('lost')}/{rec.get('checked')} -> "
+              f"{'OK' if rec['ok'] else 'FAIL'} ({out})")
+    elif args.host_kill:
         soak_rec = rec.get("soak", {})
         print(f"# drill seed={rec['seed']} "
               f"mbps={rec.get('recovery_mbps')} "
