@@ -531,6 +531,7 @@ def worker_cluster():
           attribution=out.get("attribution"),
           copy=out.get("copy"),
           profiler=out.get("profiler"),
+          net=out.get("net"),
           counters=_counter_deltas(c_pre, _lib_counters()),
           slo=_slo("cluster_write_iops",
                    out["write"].get("iops") or 0.0,

@@ -73,6 +73,16 @@ OPTIONS: Dict[str, Option] = _opts(
            "CRUSH bucket type at which failure reporters are "
            "deduplicated: reports from osds under the same subtree "
            "of this type count as ONE reporter"),
+    Option("osd_op_complaint_time", float, 0.5,
+           "seconds an op may stay in flight before it is a SLOW op: "
+           "the OpTracker historic-slow threshold AND the count the "
+           "osd's beacon reports for the monitor's SLOW_OPS health "
+           "check (one knob, both consumers)"),
+    Option("osd_heartbeat_ping_threshold_ms", float, 1000.0,
+           "heartbeat RTT window average (1/5/15 min) above this "
+           "raises OSD_SLOW_PING_TIME and makes the peer visible in "
+           "dump_osd_network (mon_warn_on_slow_ping_time role); also "
+           "the default dump_osd_network filter threshold"),
     Option("osd_heartbeat_min_peers", int, 4,
            "pad the PG-derived heartbeat peer set with other up osds "
            "until it reaches this size, so sparse PG overlap (small "

@@ -72,6 +72,19 @@ REGISTRY: Dict[str, Dict[str, str]] = {
         "frames_out": U64,
         "dispatch_lat": HIST,
         "dispatch_time": TIME,
+        # the saturation plane (PR 17): cumulative wall time _send
+        # spent pushing frames against socket backpressure, the
+        # send-queue depth observed per send, and the dispatch-queue
+        # wait + on-wire->dispatch latency split by lane — the
+        # "load masquerading as death" meters the epoll refactor
+        # (ROADMAP item 1) must prove its win against
+        "send_stall_time": TIME,
+        "send_stalls": U64,
+        "send_queue_depth": HIST,
+        "dispatch_wait_ctl": HIST,
+        "dispatch_wait_data": HIST,
+        "dispatch_lat_ctl": HIST,
+        "dispatch_lat_data": HIST,
     },
     "ec.engine": {
         "encode_ops": U64,

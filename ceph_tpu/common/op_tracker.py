@@ -91,6 +91,20 @@ class OpTracker:
             if op.duration >= self.slow_threshold:
                 self._slow.append(op)
 
+    def slow_summary(self) -> Dict:
+        """In-flight ops older than the slow threshold — the payload
+        an OSD's beacon carries so the monitor can fold a SLOW_OPS
+        health check (src/osd/OSD.cc get_health_metrics role).  Counts
+        LIVE ops only: once they drain the count hits 0 and the check
+        clears, exactly the reference's semantics."""
+        now = time.time()
+        with self._lock:
+            ages = [now - op.start for op in self._inflight.values()]
+        slow = [a for a in ages if a >= self.slow_threshold]
+        return {"count": len(slow),
+                "oldest_age": round(max(slow), 3) if slow else 0.0,
+                "threshold": self.slow_threshold}
+
     # -- admin-socket payloads ----------------------------------------
     def dump_ops_in_flight(self) -> Dict:
         with self._lock:

@@ -105,6 +105,80 @@ class _SockWriter:
 _sock_writers: Dict[int, _SockWriter] = {}
 _sock_writers_guard = make_lock("msgr::send_guard")
 
+# A send slower than this is socket backpressure (or an armed wire
+# fault), not syscall cost: only those book send_stall_time, so an
+# idle cluster's meter reads exactly zero and any nonzero value means
+# the kernel buffer pushed back.
+_STALL_MIN_S = 1e-3
+
+
+class _ConnStats:
+    """Per-connection saturation books (the ms_async per-connection
+    logger role): byte/frame volume, cumulative send-stall time, and
+    dispatch wait/latency sums split by lane — the raw material of
+    ``dump_messenger``.  Fields are bumped lock-free from reader,
+    sender and pool-worker threads; a torn ``+=`` under the GIL can
+    lose an individual sample, which telemetry tolerates (the same
+    trade the reference's perf counters make on relaxed atomics)."""
+
+    __slots__ = ("peer", "bytes_in", "bytes_out", "frames_in",
+                 "frames_out", "sends", "send_stall_s", "send_stalls",
+                 "q_depth_peak", "wait_ctl_s", "wait_ctl_n",
+                 "wait_data_s", "wait_data_n", "lat_ctl_s",
+                 "lat_ctl_n", "lat_data_s", "lat_data_n")
+
+    def __init__(self, peer: str):
+        self.peer = peer
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self.frames_in = 0
+        self.frames_out = 0
+        self.sends = 0
+        self.send_stall_s = 0.0
+        self.send_stalls = 0
+        self.q_depth_peak = 0
+        self.wait_ctl_s = 0.0
+        self.wait_ctl_n = 0
+        self.wait_data_s = 0.0
+        self.wait_data_n = 0
+        self.lat_ctl_s = 0.0
+        self.lat_ctl_n = 0
+        self.lat_data_s = 0.0
+        self.lat_data_n = 0
+
+    def dump(self) -> Dict:
+        return {
+            "peer": self.peer,
+            "bytes_in": self.bytes_in,
+            "bytes_out": self.bytes_out,
+            "frames_in": self.frames_in,
+            "frames_out": self.frames_out,
+            "sends": self.sends,
+            "send_stall_s": round(self.send_stall_s, 6),
+            "send_stalls": self.send_stalls,
+            "queue_depth_peak": self.q_depth_peak,
+            "dispatch_wait_ctl": {
+                "n": self.wait_ctl_n,
+                "avg_ms": round(1e3 * self.wait_ctl_s
+                                / self.wait_ctl_n, 3)
+                if self.wait_ctl_n else 0.0},
+            "dispatch_wait_data": {
+                "n": self.wait_data_n,
+                "avg_ms": round(1e3 * self.wait_data_s
+                                / self.wait_data_n, 3)
+                if self.wait_data_n else 0.0},
+            "dispatch_lat_ctl": {
+                "n": self.lat_ctl_n,
+                "avg_ms": round(1e3 * self.lat_ctl_s
+                                / self.lat_ctl_n, 3)
+                if self.lat_ctl_n else 0.0},
+            "dispatch_lat_data": {
+                "n": self.lat_data_n,
+                "avg_ms": round(1e3 * self.lat_data_s
+                                / self.lat_data_n, 3)
+                if self.lat_data_n else 0.0},
+        }
+
 
 def _writer_for(sock) -> _SockWriter:
     with _sock_writers_guard:
@@ -591,6 +665,21 @@ class Messenger:
         # receipt -> handler completion (queue wait + execution)
         self.pc.add_histogram("dispatch_lat")
         self.pc.add_time("dispatch_time")
+        # the saturation plane: wall time _send spent stalled against
+        # socket backpressure (only sends past _STALL_MIN_S book, so
+        # an unloaded wire reads 0), the send-queue depth seen per
+        # send, and the dispatch wait/latency histograms split by
+        # lane — what dump_messenger / `telemetry net` read
+        self.pc.add_time("send_stall_time")
+        self.pc.add_u64_counter("send_stalls")
+        self.pc.add_histogram("send_queue_depth", min_value=1.0)
+        self.pc.add_histogram("dispatch_wait_ctl")
+        self.pc.add_histogram("dispatch_wait_data")
+        self.pc.add_histogram("dispatch_lat_ctl")
+        self.pc.add_histogram("dispatch_lat_data")
+        # id(sock) -> _ConnStats, created on first traffic, reaped
+        # with the reader (dict ops are GIL-atomic; no lock)
+        self._conn_stats: Dict[int, _ConnStats] = {}
         # the byte-copy ledger (common/copytrack.py): recv/send copy
         # accounting books into the daemon's obs.copy counters when a
         # collection was passed, else the process-global ones
@@ -713,6 +802,9 @@ class Messenger:
                 msg, blobs, nbytes, seg = got
                 self.pc.inc("bytes_in", nbytes + 4)
                 self.pc.inc("frames_in")
+                cs = self._conn_stat(conn)
+                cs.bytes_in += nbytes + 4
+                cs.frames_in += 1
                 # recv copies: ONE recv_into fill of the pooled
                 # segment per frame — the data-segment slices are
                 # views into it now, so the old per-blob
@@ -730,6 +822,7 @@ class Messenger:
                     self.log.derr(f"{self.name}: dropping bad frame "
                                   f"({msg.get('type')!r}): {e!r}")
         _reap_writer(conn)
+        self._conn_stats.pop(id(conn), None)
         with self._conn_lock:
             self._accepted.discard(conn)
             tids = self._conn_waiters.pop(id(conn), set())
@@ -792,10 +885,25 @@ class Messenger:
                     self._pending[tid] = {"__session_dead__": why}
                     ev.set()
 
+    def _conn_stat(self, conn: socket.socket) -> _ConnStats:
+        cs = self._conn_stats.get(id(conn))
+        if cs is None:
+            try:
+                peer = "%s:%d" % conn.getpeername()[:2]
+            except OSError:
+                peer = "?"
+            cs = self._conn_stats.setdefault(id(conn),
+                                             _ConnStats(peer))
+        return cs
+
     def _send(self, conn: socket.socket, msg: Dict) -> None:
         """Sign-at-wire-time send: frames are stored/buffered unsigned
         (and may hold raw ``bytes`` values); the MAC is computed over
         the lifted control segment + data-segment digests."""
+        # stall clock starts BEFORE the fault block: an armed
+        # msgr.delay_frame models a slow wire, and the whole point of
+        # the meter is that slow wires surface as send stall
+        t0 = time.monotonic()
         mutate = None
         close_after = False
         if faults._ACTIVE:  # one bool test when nothing is armed
@@ -812,10 +920,26 @@ class Messenger:
             elif faults.fires("msgr.close_mid_frame", self.name):
                 mutate = _truncate_frame
                 close_after = True
+        w = _sock_writers.get(id(conn))
+        depth = len(w.q) if w is not None else 0
         n, joined = _send_frame(conn, msg, self.keyring,
                                 mutate=mutate)
         self.pc.inc("bytes_out", n)
         self.pc.inc("frames_out")
+        cs = self._conn_stat(conn)
+        cs.bytes_out += n
+        cs.frames_out += 1
+        cs.sends += 1
+        if depth:
+            self.pc.hist_add("send_queue_depth", depth)
+            if depth > cs.q_depth_peak:
+                cs.q_depth_peak = depth
+        stall = time.monotonic() - t0
+        if stall >= _STALL_MIN_S:
+            self.pc.tinc("send_stall_time", stall)
+            self.pc.inc("send_stalls")
+            cs.send_stall_s += stall
+            cs.send_stalls += 1
         # send copies: the uncontended path gathers the frame straight
         # from the caller's buffers (sendmsg scatter-gather — zero
         # userspace join); only the contended/fault paths materialise
@@ -1021,6 +1145,7 @@ class Messenger:
                       ins: Optional[_InSession], seq, nbytes: int,
                       t_rx: Optional[float] = None) -> None:
         type_ = msg.get("type", "")
+        ctl = type_ in self._control
         throttle = self.throttles.get(type_)
         if throttle is not None:
             if nbytes > throttle.max:
@@ -1054,9 +1179,22 @@ class Messenger:
                     if t_rx is not None:
                         # frame receipt -> handler start: the dispatch
                         # queue wait, split into its own attribution
-                        # stage (common/attribution.py)
-                        sp.set_tag("q_wait",
-                                   round(time.monotonic() - t_rx, 6))
+                        # stage (common/attribution.py) AND the
+                        # per-lane wait histogram (the DispatchQueue
+                        # saturation signal dump_messenger reads)
+                        q_wait = time.monotonic() - t_rx
+                        sp.set_tag("q_wait", round(q_wait, 6))
+                        cs = self._conn_stat(conn)
+                        if ctl:
+                            self.pc.hist_add("dispatch_wait_ctl",
+                                             q_wait)
+                            cs.wait_ctl_s += q_wait
+                            cs.wait_ctl_n += 1
+                        else:
+                            self.pc.hist_add("dispatch_wait_data",
+                                             q_wait)
+                            cs.wait_data_s += q_wait
+                            cs.wait_data_n += 1
                     # watchdog-visible: a handler wedged on a lock or a
                     # peer RPC shows up in dump_blocked with its stack
                     with watchdog.section(f"{self.name}:{type_}"):
@@ -1111,6 +1249,57 @@ class Messenger:
             dt = time.monotonic() - t_rx
             self.pc.hist_add("dispatch_lat", dt)
             self.pc.tinc("dispatch_time", dt)
+            cs = self._conn_stat(conn)
+            if ctl:
+                self.pc.hist_add("dispatch_lat_ctl", dt)
+                cs.lat_ctl_s += dt
+                cs.lat_ctl_n += 1
+            else:
+                self.pc.hist_add("dispatch_lat_data", dt)
+                cs.lat_data_s += dt
+                cs.lat_data_n += 1
+
+    # -- the saturation surface (dump_messenger) -----------------------
+    def dump_messenger(self) -> Dict:
+        """Per-connection send/dispatch saturation books, worst
+        stall first — the `ceph daemon ... dump_messenger` payload.
+        Live queue depth/bytes come from the socket's writer queue at
+        dump time; the cumulative books from _ConnStats."""
+        conns = []
+        for cid, cs in list(self._conn_stats.items()):
+            entry = cs.dump()
+            w = _sock_writers.get(cid)
+            q = list(w.q) if w is not None else []
+            entry["queue_depth"] = len(q)
+            entry["queue_bytes"] = sum(len(o.buf) for o in q)
+            conns.append(entry)
+        conns.sort(key=lambda c: (c["send_stall_s"],
+                                  c["queue_bytes"],
+                                  c["bytes_out"]), reverse=True)
+        dump = self.pc.dump()
+        return {
+            "name": self.name,
+            "addr": list(self.addr),
+            "num_connections": len(conns),
+            "connections": conns,
+            "totals": {
+                "send_stall_s": round(
+                    float(dump.get("send_stall_time", 0.0)), 6),
+                "send_stalls": int(dump.get("send_stalls", 0)),
+                "bytes_in": int(dump.get("bytes_in", 0)),
+                "bytes_out": int(dump.get("bytes_out", 0)),
+                "frames_in": int(dump.get("frames_in", 0)),
+                "frames_out": int(dump.get("frames_out", 0)),
+            },
+        }
+
+    def wire(self, admin_socket) -> None:
+        """Admin-socket surface: dump_messenger beside the daemon's
+        optracker/tracer dumps."""
+        admin_socket.register(
+            "dump_messenger",
+            lambda _a: self.dump_messenger(),
+            "per-connection send-stall / dispatch-wait books")
 
     def _reply(self, conn, msg: Dict, payload: Dict) -> None:
         if msg.get("tid") is not None:
@@ -1425,3 +1614,4 @@ class Messenger:
             self._accepted.clear()
         for sock in socks:
             self._hard_close(sock)
+        self._conn_stats.clear()

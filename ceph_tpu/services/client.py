@@ -101,7 +101,9 @@ class Client(MapFollower):
                                       self._aio_window)
         self._aio_pool = None  # lazy: sync-only clients never pay it
         self._aio_inflight: set = set()
-        self.optracker = OpTracker()
+        self.optracker = OpTracker(
+            history_slow_threshold=ctx.conf["osd_op_complaint_time"]
+            if ctx is not None else 0.5)
         if ctx is not None and ctx.conf["admin_socket"]:
             sock = ctx.start_admin_socket()
             self.optracker.wire(sock)

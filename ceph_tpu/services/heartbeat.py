@@ -27,9 +27,10 @@ latency grows is not storm-reported (the reference's
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from ..analysis.lockdep import make_lock
 
@@ -40,17 +41,43 @@ from ..analysis.lockdep import make_lock
 EWMA_ALPHA = 0.3
 GRACE_LAT_FACTOR = 4.0
 
+# dump_osd_network / OSD_SLOW_PING_TIME window spans, seconds — the
+# reference's 1/5/15-minute ping-time averages (osd_mon_heartbeat_
+# stat_stale windows in OSD::heartbeat_check).  A ring of 4096
+# timestamped samples covers 15 min at the default 0.5s interval with
+# room for a few peers' worth of bursts.
+WINDOWS = ((60.0, "1min"), (300.0, "5min"), (900.0, "15min"))
+_RTT_RING = 4096
+
 
 class _Peer:
     """Per-peer clock state (one heartbeat_info_t)."""
 
-    __slots__ = ("last_ack", "ewma")
+    __slots__ = ("last_ack", "ewma", "rtts")
 
     def __init__(self, now: float):
         # a fresh peer gets a full grace window from discovery — it
         # has never been asked, so it cannot already be overdue
         self.last_ack = now
         self.ewma = 0.0
+        # (monotonic stamp, rtt_s) ring — the window averages behind
+        # dump_osd_network and the OSD_SLOW_PING_TIME breach report
+        self.rtts: collections.deque = collections.deque(
+            maxlen=_RTT_RING)
+
+    def window_avgs_ms(self, now: float) -> Dict[str, float]:
+        """Mean RTT (ms) per lookback window over the sample ring."""
+        sums = [0.0] * len(WINDOWS)
+        ns = [0] * len(WINDOWS)
+        for t, rtt in self.rtts:
+            age = now - t
+            for i, (span, _label) in enumerate(WINDOWS):
+                if age <= span:
+                    sums[i] += rtt
+                    ns[i] += 1
+        return {label: round(1e3 * sums[i] / ns[i], 3)
+                if ns[i] else 0.0
+                for i, (_span, label) in enumerate(WINDOWS)}
 
 
 class HeartbeatPlane:
@@ -64,6 +91,8 @@ class HeartbeatPlane:
         conf = svc.ctx.conf
         self.interval: float = conf["osd_heartbeat_interval"]
         self.grace: float = conf["osd_heartbeat_grace"]
+        self.ping_threshold_ms: float = \
+            conf["osd_heartbeat_ping_threshold_ms"]
         self._lock = make_lock("osd::hb")
         self._peers: Dict[int, _Peer] = {}
         self._stop = threading.Event()
@@ -192,7 +221,59 @@ class HeartbeatPlane:
             peer.last_ack = now
             peer.ewma = rtt if peer.ewma == 0.0 else (
                 EWMA_ALPHA * rtt + (1.0 - EWMA_ALPHA) * peer.ewma)
+            peer.rtts.append((now, rtt))
         self.pc.inc("acks")
         self.pc.tinc("ping_time", rtt)
         self.pc.hist_add("ping_lat", rtt)
         return None
+
+    # -- the network-health surface (dump_osd_network) -----------------
+    def dump_network(self,
+                     threshold_ms: Optional[float] = None) -> Dict:
+        """Per-peer RTT window averages, worst first — the `ceph
+        daemon osd.N dump_osd_network` payload.  Only peers whose
+        worst window average reaches ``threshold_ms`` are listed
+        (0 lists everything); the default threshold is the
+        OSD_SLOW_PING_TIME knob, so the dump shows exactly the peers
+        the health check would complain about."""
+        if threshold_ms is None:
+            threshold_ms = self.ping_threshold_ms
+        now = time.monotonic()
+        with self._lock:
+            peers = {o: (p.window_avgs_ms(now),
+                         list(p.rtts)[-1][1] if p.rtts else None)
+                     for o, p in self._peers.items()}
+        entries = []
+        for osd, (avgs, last) in peers.items():
+            worst = max(avgs.values()) if avgs else 0.0
+            e = {"peer": osd, "worst_ms": worst,
+                 "last_ms": round(1e3 * last, 3)
+                 if last is not None else None}
+            e.update(avgs)
+            entries.append(e)
+        entries.sort(key=lambda e: e["worst_ms"], reverse=True)
+        shown = [e for e in entries
+                 if threshold_ms <= 0 or e["worst_ms"] >= threshold_ms]
+        return {"osd": self.svc.id,
+                "threshold_ms": threshold_ms,
+                "total_peers": len(entries),
+                "entries": shown}
+
+    def ping_breaches(self) -> List[Dict]:
+        """Peers whose worst window average crosses the threshold —
+        the compact list the OSD beacon carries so the monitor can
+        raise OSD_SLOW_PING_TIME with per-pair attribution."""
+        dump = self.dump_network()
+        return [{"peer": e["peer"], "avg_ms": e["worst_ms"]}
+                for e in dump["entries"]
+                if e["worst_ms"] >= dump["threshold_ms"] > 0]
+
+    def wire(self, admin_socket) -> None:
+        def _dump(args: Dict) -> Dict:
+            thr = args.get("threshold_ms")
+            return self.dump_network(
+                float(thr) if thr is not None else None)
+
+        admin_socket.register(
+            "dump_osd_network", _dump,
+            "heartbeat RTT window averages per peer (worst first)")

@@ -100,6 +100,12 @@ class Monitor:
         # osd -> last time we pushed the map at a beating-but-down
         # daemon (rate limit for the wrongly-marked-down nudge)
         self._down_nudge: Dict[int, float] = {}
+        # osd -> the SLO cargo its last beacon carried (slow-op count
+        # + oldest age, heartbeat-RTT threshold breaches) with receipt
+        # stamp: what _h_health folds into SLOW_OPS /
+        # OSD_SLOW_PING_TIME, aged out with the stats grace so a dead
+        # daemon's stale complaint can't pin health at WARN
+        self._osd_slo: Dict[int, Dict] = {}
         # osd -> pre-out weight, for osds the MONITOR outed (auto-out);
         # restored on boot, unlike an admin mark_out which sticks
         self._auto_out: Dict[int, int] = {}
@@ -125,7 +131,9 @@ class Monitor:
         self.pc.add_time("commit_time")
         # write commands register here (the leader-side op surface);
         # dump_ops_in_flight / dump_historic_ops over the admin socket
-        self.optracker = OpTracker()
+        # — slow threshold on the same knob as the osds' SLOW_OPS
+        self.optracker = OpTracker(
+            history_slow_threshold=ctx.conf["osd_op_complaint_time"])
 
         # write commands mutate the map: leader-only in quorum mode
         # (forwarded there); reads are served by any member
@@ -293,6 +301,7 @@ class Monitor:
             sock = self.ctx.start_admin_socket()
             self.optracker.wire(sock)
             self.tracer.wire(sock)
+            self.msgr.wire(sock)   # dump_messenger
         self._load_store()
         self.msgr.start()
         self._running = True
@@ -576,6 +585,13 @@ class Monitor:
         with self._lock:
             now = time.monotonic()
             self._last_beat[osd] = now
+            # SLO cargo: overwrite each beat, so a beacon WITHOUT the
+            # keys (ops drained, pings recovered) clears the daemon's
+            # entry and the health checks fall away with it
+            self._osd_slo[osd] = {
+                "ts": now,
+                "slow_ops": msg.get("slow_ops"),
+                "slow_pings": msg.get("slow_pings")}
             if self.map.exists(osd) and not self.map.is_up(osd) \
                     and self._committed_epoch \
                     and now - self._down_nudge.get(osd, 0.0) > 1.0:
@@ -992,7 +1008,47 @@ class Monitor:
             if self._mgr_health is not None and \
                     now - self._mgr_health["ts"] < grace:
                 mgr_checks = dict(self._mgr_health["checks"])
+            # fresh per-daemon SLO cargo from the beacons: slow ops
+            # (SLOW_OPS) and heartbeat-RTT breaches
+            # (OSD_SLOW_PING_TIME); entries past the grace are a dead
+            # or wedged reporter's last words, not live state
+            slow_ops: Dict[int, Dict] = {}
+            slow_pings: Dict[int, list] = {}
+            for osd, e in list(self._osd_slo.items()):
+                if now - e["ts"] > 4 * grace:
+                    del self._osd_slo[osd]
+                    continue
+                if now - e["ts"] > grace:
+                    continue
+                so = e.get("slow_ops")
+                if so and so.get("count"):
+                    slow_ops[osd] = so
+                sp = e.get("slow_pings")
+                if sp:
+                    slow_pings[osd] = sp
         checks = []
+        if slow_ops:
+            # the reference's `N slow ops, oldest one blocked for X
+            # sec, daemons [osd.a,osd.b] have slow ops.` summary line
+            total = sum(int(s.get("count", 0))
+                        for s in slow_ops.values())
+            oldest = max(float(s.get("oldest_age", 0.0))
+                         for s in slow_ops.values())
+            daemons = [f"osd.{o}" for o in sorted(slow_ops)]
+            checks.append(
+                f"SLOW_OPS: {total} slow ops, oldest one blocked "
+                f"for {oldest:.1f} sec, daemons {daemons} have "
+                f"slow ops.")
+        if slow_pings:
+            pairs = sorted(
+                ((o, int(b["peer"]), float(b["avg_ms"]))
+                 for o, bs in slow_pings.items() for b in bs),
+                key=lambda p: p[2], reverse=True)
+            worst = ", ".join(f"osd.{a}->osd.{b} {ms:.0f}ms"
+                              for a, b, ms in pairs[:8])
+            checks.append(
+                f"OSD_SLOW_PING_TIME: {len(pairs)} slow osd "
+                f"heartbeat pings (worst first): {worst}")
         if down:
             checks.append(f"OSD_DOWN: {len(down)} osds down: {down}")
         if flapping:

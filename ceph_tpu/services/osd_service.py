@@ -115,7 +115,11 @@ class OSDService(MapFollower):
         from ..common.op_queue import OpScheduler
         from ..common.op_tracker import OpTracker
 
-        self.optracker = OpTracker()
+        # the SLOW_OPS knob: one threshold feeds both the historic-
+        # slow ring and the slow-op count the beacon reports to the
+        # monitor's health fold
+        self.optracker = OpTracker(
+            history_slow_threshold=ctx.conf["osd_op_complaint_time"])
         # cross-thread EC encode coalescing: concurrent same-pool
         # writes share one batched engine dispatch (ec/batcher.py)
         from ..ec.batcher import EncodeBatcher
@@ -249,6 +253,8 @@ class OSDService(MapFollower):
             sock = self.ctx.start_admin_socket()
             self.optracker.wire(sock)
             self.tracer.wire(sock)
+            self.msgr.wire(sock)   # dump_messenger
+            self.hb.wire(sock)     # dump_osd_network
         self.msgr.start()
         self._running = True
         boot = self.mon_call({"type": "boot", "osd": self.id,
@@ -407,9 +413,6 @@ class OSDService(MapFollower):
         from ..ec.stripe import crc32c
 
         if faults._ACTIVE:  # one bool test when nothing is armed
-            # the slow-disk delay, BEFORE the PG lock: a slow op must
-            # stall itself, not everything queued behind the lock
-            faults.sleep_if("osd.slow_op", f"osd.{self.id}")
             if faults.fires("osd.kill_before_commit",
                             f"osd.{self.id}"):
                 # died before the WAL commit: no data, no ack — the
@@ -421,6 +424,13 @@ class OSDService(MapFollower):
         with self.optracker.create(
                 "osd_op", f"write {cid}/{oid} from "
                           f"{msg.get('frm')}") as op:
+            if faults._ACTIVE:
+                # the slow-disk delay, BEFORE the PG lock (a slow op
+                # must stall itself, not everything queued behind the
+                # lock) but INSIDE the tracked scope: the op ages
+                # visibly in dump_ops_in_flight and the SLOW_OPS
+                # beacon while it sleeps, as a real slow disk would
+                faults.sleep_if("osd.slow_op", f"osd.{self.id}")
             # per-PG lock, not the global one: a WALStore fsync per
             # write must never serialize the whole daemon or stall map
             # handling behind the write stream.  Bounded: a miss
@@ -1318,7 +1328,23 @@ class OSDService(MapFollower):
         while self._running:
             # mon_send reaches every quorum member: peons forward to
             # the leader, so liveness survives any single monitor death
-            self.mon_send({"type": "heartbeat", "osd": self.id})
+            # — carrying this daemon's SLO state: in-flight ops past
+            # osd_op_complaint_time and heartbeat-RTT threshold
+            # breaches, the raw material of the monitor's SLOW_OPS /
+            # OSD_SLOW_PING_TIME health folds
+            beat: Dict = {"type": "heartbeat", "osd": self.id}
+            try:
+                slow = self.optracker.slow_summary()
+                if slow["count"]:
+                    beat["slow_ops"] = slow
+                pings = self.hb.ping_breaches()
+                if pings:
+                    beat["slow_pings"] = pings
+            except Exception as e:
+                # the beacon is liveness first; SLO cargo never gets
+                # to break it
+                self.log.dout(5, f"slo beacon cargo failed: {e}")
+            self.mon_send(beat)
             # a monitor that deferred our boot (markdown dampening) or
             # marked us down while our re-boot raced a commit leaves
             # the map showing us down with no new epoch to react to:

@@ -17,8 +17,8 @@ CLI:
         osd down ID | pool ls | pool create ID PGS SIZE |
         pool delete ID | pool-stats [ID] | progress
     python -m ceph_tpu.tools.ceph_cli --asok-dir DIR \
-        daemonperf | top | history | latency |
-        telemetry snapshot|prom|traces|flame|profile
+        daemonperf | top | history | latency | net |
+        telemetry snapshot|prom|traces|flame|profile|net
     python -m ceph_tpu.tools.ceph_cli --asok-dir DIR \
         balancer status|on|off|eval|execute |
         mgr module ls|enable|disable NAME
@@ -184,7 +184,7 @@ def main(argv=None) -> int:
     # monitor, no messenger.  `top` and `history` are the continuous
     # plane (per-daemon metrics-history rings + live rate frames).
     if args.verb[0] in ("daemonperf", "telemetry", "top",
-                        "history", "latency"):
+                        "history", "latency", "net"):
         from . import telemetry
 
         if not args.asok_dir:

@@ -214,6 +214,7 @@ def bench_minicluster(op: str = "write", seconds: float = 5.0,
     # (the trace_sample_rate knob exists for exactly this call)
     conf.set("trace_sample_rate", 0.0)
     cluster = MiniCluster(n_osds=n_osds, config=conf).start()
+    t_boot = time.monotonic()
     try:
         if ec:
             prof = {"plugin": "jerasure",
@@ -408,6 +409,16 @@ def bench_minicluster(op: str = "write", seconds: float = 5.0,
                 100.0 * self_s / elapsed, 2)
             if elapsed > 0 else 0.0,
         }
+
+        # saturation plane (PR 17): fold the run's cumulative msgr
+        # books into the cluster net summary — send-stall share,
+        # dispatch p99 and the worst heartbeat peers.  A fresh
+        # snapshot here (not ``snap``) covers the profiler bursts
+        # too; with no prev snapshot net_summary treats the books as
+        # one whole-run delta over dt.
+        net_snap = _tel.cluster_snapshot(cluster.asok_dir)
+        out["net"] = _tel.net_summary(
+            net_snap, dt=time.monotonic() - t_boot)
 
         out["pool"] = "ec(2,1)" if ec else "replicated(size=" + \
             str(min(3, n_osds)) + ")"

@@ -70,7 +70,9 @@ def poll_daemon(path: str, timeout: float = 5.0) -> Optional[Dict]:
     for key, prefix in (("perf", "perf dump"),
                         ("tracing", "dump_tracing"),
                         ("ops_in_flight", "dump_ops_in_flight"),
-                        ("historic_ops", "dump_historic_ops")):
+                        ("historic_ops", "dump_historic_ops"),
+                        ("messenger", "dump_messenger"),
+                        ("network", "dump_osd_network")):
         try:
             got = AdminSocket.request(path, prefix, timeout=timeout)
         except (OSError, ValueError):
@@ -220,10 +222,13 @@ def _column_value(perf: Dict, logger_glob: str, key: str) -> float:
 
 
 def _time_value(perf: Dict, logger_glob: str, key: str,
-                sub: str) -> float:
-    """Sum one field of a TIME counter's {avgcount, sum} dump across
-    matching loggers (time counters dump as dicts, which
-    _column_value deliberately skips)."""
+                sub: str = "sum") -> float:
+    """Sum a TIME counter across matching loggers.  PerfCounters
+    dumps TIME counters as PLAIN floats (the cumulative seconds), so
+    a number counts directly as the ``sum``; AVG-style {avgcount,
+    sum} dicts contribute the requested field.  (The old dict-only
+    version silently read 0.0 for every real TIME counter — the
+    daemonperf `hb lat` column was computed from nothing.)"""
     total = 0.0
     for logger, counters in (perf or {}).items():
         if not fnmatch.fnmatch(logger, logger_glob):
@@ -231,7 +236,57 @@ def _time_value(perf: Dict, logger_glob: str, key: str,
         val = (counters or {}).get(key)
         if isinstance(val, dict):
             total += float(val.get(sub, 0) or 0)
+        elif isinstance(val, (int, float)) and sub == "sum":
+            total += float(val)
     return total
+
+
+def _hist_buckets(perf: Dict, logger_glob: str,
+                  key: str) -> Tuple[List[float], float]:
+    """Summed bucket counts (+ the log2 floor) of a HISTOGRAM counter
+    across matching loggers."""
+    total: List[float] = []
+    lo: Optional[float] = None
+    for logger, counters in (perf or {}).items():
+        if not fnmatch.fnmatch(logger, logger_glob):
+            continue
+        val = (counters or {}).get(key)
+        if isinstance(val, dict) and "buckets" in val:
+            b = val["buckets"]
+            if len(b) > len(total):
+                total.extend([0.0] * (len(b) - len(total)))
+            for i, n in enumerate(b):
+                total[i] += n
+            if lo is None:
+                lo = float(val.get("min", 1e-6))
+    return total, (lo if lo is not None else 1e-6)
+
+
+def hist_quantile(buckets: List[float], min_value: float,
+                  q: float) -> float:
+    """Upper-edge quantile from a log2 bucket list (bucket 0 holds
+    values <= min, bucket i holds (min*2^(i-1), min*2^i]): the bound
+    is conservative by at most one octave, which is what a log2
+    histogram can honestly promise."""
+    n = sum(buckets)
+    if n <= 0:
+        return 0.0
+    target = q * n
+    cum = 0.0
+    for i, c in enumerate(buckets):
+        cum += c
+        if cum >= target:
+            return min_value * (2.0 ** i)
+    return min_value * (2.0 ** max(0, len(buckets) - 1))
+
+
+def _hist_delta(cperf: Dict, pperf: Dict, glob: str,
+                key: str) -> Tuple[List[float], float]:
+    """Bucket-wise delta of a histogram between two snapshots."""
+    cb, lo = _hist_buckets(cperf, glob, key)
+    pb, _lo = _hist_buckets(pperf, glob, key)
+    return [c - (pb[i] if i < len(pb) else 0.0)
+            for i, c in enumerate(cb)], lo
 
 
 # op-throughput counters the derived cp/op column divides by —
@@ -277,18 +332,22 @@ def daemonperf_view(prev: Dict, cur: Dict,
     (logger glob, key), values are deltas/second between the two
     snapshots.
 
-    ``derived`` appends three computed columns: ``cp/op`` (delta
-    obs.copy bytes_copied / delta ops — host bytes copied per op) and
+    ``derived`` appends computed columns: ``cp/op`` (delta obs.copy
+    bytes_copied / delta ops — host bytes copied per op) and
     ``unattr%`` (the unattributed critical-path share of the daemon's
-    completed traces) from the PR-13 observability families, plus
-    ``hb lat`` — the mean peer ping RTT in ms over the window (delta
+    completed traces) from the PR-13 observability families; ``hb
+    lat`` — the mean peer ping RTT in ms over the window (delta
     osd.hb ping_time sum / delta acks), the live view of the failure
-    detector's latency EWMA input."""
+    detector's latency EWMA input; and the PR-17 saturation pair:
+    ``stall%`` (share of the window spent in send stall against
+    socket backpressure) and ``dq p99`` (dispatch-queue wait p99 in
+    ms over the window, both lanes)."""
     columns = columns or DEFAULT_COLUMNS
     dt = max(1e-9, cur.get("ts", 0) - prev.get("ts", 0))
     headers = [h for _g, _k, h in columns]
     if derived:
-        headers = headers + ["cp/op", "unattr%", "hb lat"]
+        headers = headers + ["cp/op", "unattr%", "hb lat",
+                             "stall%", "dq p99"]
     width = max(8, *(len(h) + 1 for h in headers))
     name_w = max([len("daemon")] +
                  [len(d) for d in cur.get("daemons", {})]) + 1
@@ -324,7 +383,126 @@ def daemonperf_view(prev: Dict, cur: Dict,
                       - _column_value(pperf, "osd.hb.*", "acks"))
             cells.append((f"{d_rtt / d_acks * 1000:.1f}"
                           if d_acks > 0 else "-").rjust(width))
+            d_stall = (_time_value(cperf, "msgr.*",
+                                   "send_stall_time")
+                       - _time_value(pperf, "msgr.*",
+                                     "send_stall_time"))
+            cells.append(f"{max(0.0, d_stall) / dt:.1%}"
+                         .rjust(width))
+            wb_c, w_lo = _hist_delta(cperf, pperf, "msgr.*",
+                                     "dispatch_wait_ctl")
+            wb_d, _ = _hist_delta(cperf, pperf, "msgr.*",
+                                  "dispatch_wait_data")
+            if len(wb_c) < len(wb_d):
+                wb_c.extend([0.0] * (len(wb_d) - len(wb_c)))
+            merged = [a + (wb_d[i] if i < len(wb_d) else 0.0)
+                      for i, a in enumerate(wb_c)]
+            cells.append((f"{1e3 * hist_quantile(merged, w_lo, 0.99):.1f}"
+                          if sum(merged) > 0 else "-").rjust(width))
         lines.append(daemon.ljust(name_w) + "".join(cells))
+    return "\n".join(lines)
+
+
+# -- the saturation plane (telemetry net, PR 17) ----------------------
+
+def net_summary(cur: Dict, prev: Optional[Dict] = None,
+                dt: Optional[float] = None) -> Dict:
+    """Cluster messenger-saturation roll-up between two snapshots
+    (``prev=None`` with an explicit ``dt`` treats ``cur``'s cumulative
+    counters as the whole-run delta — how the bench commits its
+    ``net.*`` trajectory columns).
+
+    Per daemon: send-stall share (seconds stalled against socket
+    backpressure per wall second), dispatch wait/latency p99 (data
+    lane), and per-lane dispatch rates.  Cluster: the same folded
+    across daemons, plus the worst heartbeat-RTT peers from any
+    ``dump_osd_network`` payloads in the snapshot."""
+    if dt is None:
+        dt = max(1e-9, cur.get("ts", 0)
+                 - (prev or {}).get("ts", 0))
+    prev_daemons = (prev or {}).get("daemons", {})
+    per: Dict[str, Dict] = {}
+    tot_stall = 0.0
+    all_lat: List[float] = []
+    all_lo = 1e-6
+    slow_peers: List[Dict] = []
+    for daemon, data in sorted(cur.get("daemons", {}).items()):
+        cperf = data.get("perf") or {}
+        pperf = (prev_daemons.get(daemon, {}).get("perf")) or {}
+        stall = (_time_value(cperf, "msgr.*", "send_stall_time")
+                 - _time_value(pperf, "msgr.*", "send_stall_time"))
+        wait_b, wait_lo = _hist_delta(cperf, pperf, "msgr.*",
+                                      "dispatch_wait_data")
+        lat_b, lat_lo = _hist_delta(cperf, pperf, "msgr.*",
+                                    "dispatch_lat_data")
+        ctl_b, _ = _hist_delta(cperf, pperf, "msgr.*",
+                               "dispatch_lat_ctl")
+        per[daemon] = {
+            "send_stall_s": round(max(0.0, stall), 6),
+            "send_stall_share": round(max(0.0, stall) / dt, 6),
+            "dispatch_wait_p99_ms": round(
+                1e3 * hist_quantile(wait_b, wait_lo, 0.99), 3),
+            "dispatch_p99_ms": round(
+                1e3 * hist_quantile(lat_b, lat_lo, 0.99), 3),
+            "ctl_per_s": round(sum(ctl_b) / dt, 1),
+            "data_per_s": round(sum(lat_b) / dt, 1),
+        }
+        tot_stall += max(0.0, stall)
+        if len(lat_b) > len(all_lat):
+            all_lat.extend([0.0] * (len(lat_b) - len(all_lat)))
+        for i, n in enumerate(lat_b):
+            all_lat[i] += n
+        all_lo = lat_lo
+        net = data.get("network")
+        if isinstance(net, dict):
+            for e in net.get("entries", []):
+                slow_peers.append({
+                    "daemon": daemon, "peer": e.get("peer"),
+                    "worst_ms": e.get("worst_ms", 0.0)})
+    slow_peers.sort(key=lambda e: e["worst_ms"], reverse=True)
+    n_daemons = max(1, len(per))
+    return {
+        "dt_s": round(dt, 3),
+        "send_stall_s": round(tot_stall, 6),
+        # stall share normalized per daemon: 1.0 would mean every
+        # daemon spent every wall second pushing against a full
+        # socket buffer
+        "send_stall_share": round(tot_stall / (dt * n_daemons), 6),
+        "dispatch_p99_ms": round(
+            1e3 * hist_quantile(all_lat, all_lo, 0.99), 3),
+        "per_daemon": per,
+        "slow_peers": slow_peers[:16],
+    }
+
+
+def net_view(cur: Dict, prev: Optional[Dict] = None,
+             dt: Optional[float] = None) -> str:
+    """Render net_summary as the `telemetry net` table."""
+    s = net_summary(cur, prev=prev, dt=dt)
+    headers = ("stall%", "dq p99", "lat p99", "ctl/s", "data/s")
+    width = max(9, *(len(h) + 1 for h in headers))
+    name_w = max([len("daemon")] + [len(d) for d in s["per_daemon"]]
+                 ) + 1
+    lines = [f"net saturation over {s['dt_s']}s — cluster stall "
+             f"share {s['send_stall_share']:.2%}, dispatch p99 "
+             f"{s['dispatch_p99_ms']:.2f}ms",
+             "daemon".ljust(name_w)
+             + "".join(h.rjust(width) for h in headers)]
+    for daemon, row in sorted(
+            s["per_daemon"].items(),
+            key=lambda kv: kv[1]["send_stall_share"], reverse=True):
+        lines.append(
+            daemon.ljust(name_w)
+            + f"{row['send_stall_share']:.2%}".rjust(width)
+            + f"{row['dispatch_wait_p99_ms']:.2f}".rjust(width)
+            + f"{row['dispatch_p99_ms']:.2f}".rjust(width)
+            + f"{row['ctl_per_s']:.1f}".rjust(width)
+            + f"{row['data_per_s']:.1f}".rjust(width))
+    if s["slow_peers"]:
+        worst = ", ".join(
+            f"{e['daemon']}->osd.{e['peer']} {e['worst_ms']:.0f}ms"
+            for e in s["slow_peers"][:8])
+        lines.append(f"slow heartbeat peers (worst first): {worst}")
     return "\n".join(lines)
 
 
@@ -553,7 +731,8 @@ def main(argv=None) -> int:
                     help="directory of daemon *.asok sockets")
     ap.add_argument("cmd", choices=("snapshot", "prom", "traces",
                                     "daemonperf", "history", "top",
-                                    "latency", "flame", "profile"))
+                                    "latency", "flame", "profile",
+                                    "net"))
     ap.add_argument("--trace-id", help="traces: reassemble this id")
     ap.add_argument("--root",
                     help="traces: only traces whose root span has "
@@ -653,6 +832,17 @@ def main(argv=None) -> int:
             time.sleep(args.interval)
             cur = cluster_snapshot(args.asok_dir)
             print(daemonperf_view(prev, cur))
+            prev = cur
+    elif args.cmd == "net":
+        prev = snap
+        for _ in range(max(1, args.count)):
+            time.sleep(args.interval)
+            cur = cluster_snapshot(args.asok_dir)
+            if args.json:
+                print(json.dumps(net_summary(cur, prev=prev),
+                                 indent=1, default=str))
+            else:
+                print(net_view(cur, prev=prev))
             prev = cur
     return 0
 

@@ -696,6 +696,39 @@ def test_obs002_registry_sync(monkeypatch):
     assert any("rogue_site_copies" in v.message for v in vs)
 
 
+def test_obs003_prometheus_export_roundtrip(monkeypatch):
+    """Every registered counter must come back from to_prometheus
+    with its sanitized family HELP header; an exporter that drops a
+    family (or a sanitize collision merging two types) is OBS003."""
+    from ceph_tpu.common import counters
+    from ceph_tpu.tools import telemetry
+
+    assert lint_obs.lint_prometheus_export() == []
+    # exporter drift: the scrape silently loses one family
+    real = telemetry.to_prometheus
+
+    def dropping(snapshot, prefix="ceph_tpu"):
+        return "\n".join(
+            line for line in real(snapshot, prefix).splitlines()
+            if "ceph_tpu_ops_w" not in line) + "\n"
+
+    monkeypatch.setattr(telemetry, "to_prometheus", dropping)
+    vs = lint_obs.lint_prometheus_export()
+    assert vs and all(v.code == "OBS003" for v in vs)
+    assert any("ops_w" in v.message for v in vs)
+    monkeypatch.setattr(telemetry, "to_prometheus", real)
+    # sanitization collision: 'op.lat' (u64) merges into the family
+    # of the registered 'op_lat' histogram -> conflicting # TYPE
+    reg = {fam: dict(names)
+           for fam, names in counters.REGISTRY.items()}
+    reg["client"]["op.lat"] = counters.U64
+    monkeypatch.setattr(counters, "REGISTRY", reg)
+    vs = lint_obs.lint_prometheus_export()
+    collisions = [v for v in vs if "merges" in v.message]
+    assert collisions and collisions[0].code == "OBS003"
+    assert "op_lat" in collisions[0].message
+
+
 def test_obs002_profile_start_must_be_gated(tmp_path):
     """The wallclock sampler is off by default: an unconditional
     profile_start() in daemon code is a violation; the admin-verb

@@ -1,0 +1,245 @@
+"""Saturation & SLO plane (PR 17): messenger backpressure books,
+SLOW_OPS health escalation, and heartbeat ping-time health.
+
+Units first (OpTracker.slow_summary, the heartbeat RTT window math,
+the telemetry net roll-up), then the acceptance drill: a MiniCluster
+under write load with ONE throttled OSD must show nonzero send-stall
+on that daemon only (dump_messenger over the admin socket), a
+SLOW_OPS health check naming it that clears back to HEALTH_OK when
+the stall is healed, and OSD_SLOW_PING_TIME with the slow peer worst
+first in dump_osd_network.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.common.admin_socket import AdminSocket
+from ceph_tpu.common.config import Config
+from ceph_tpu.common.op_tracker import OpTracker
+from ceph_tpu.services.cluster import MiniCluster
+from ceph_tpu.services.heartbeat import _Peer
+from ceph_tpu.tools import telemetry
+
+
+# -- unit: the OpTracker slow-op summary ------------------------------
+
+def test_slow_summary_counts_aged_inflight_ops():
+    t = OpTracker(history_slow_threshold=0.05)
+    assert t.slow_summary() == {"count": 0, "oldest_age": 0.0,
+                                "threshold": 0.05}
+    with t.create("osd_op", "young"):
+        with t.create("osd_op", "old"):
+            time.sleep(0.08)
+            s = t.slow_summary()
+            # both ops are in flight and both are past the threshold
+            assert s["count"] == 2
+            assert s["oldest_age"] >= 0.08
+            assert s["threshold"] == 0.05
+    # completed ops leave the in-flight summary (they live on in the
+    # historic-slow ring, which is dump_historic_slow_ops' concern)
+    assert t.slow_summary()["count"] == 0
+
+
+def test_slow_threshold_rides_config_knob():
+    """Satellite 1: osd_op_complaint_time IS the tracker threshold —
+    one knob for dump_historic_slow_ops and the SLOW_OPS beacon."""
+    conf = Config()
+    assert conf["osd_op_complaint_time"] == \
+        OpTracker().slow_threshold == 0.5
+
+
+# -- unit: heartbeat RTT windows --------------------------------------
+
+def test_peer_window_averages_age_out():
+    now = 10_000.0
+    p = _Peer(now)
+    p.rtts.append((now - 500.0, 0.400))   # only the 15min window
+    p.rtts.append((now - 120.0, 0.100))   # 5min + 15min
+    p.rtts.append((now - 10.0, 0.020))    # all three
+    avgs = p.window_avgs_ms(now)
+    assert avgs["1min"] == pytest.approx(20.0)
+    assert avgs["5min"] == pytest.approx(60.0)    # (100+20)/2 ms
+    assert avgs["15min"] == pytest.approx(1e3 * 0.52 / 3,
+                                          abs=1e-3)
+    # an empty ring reads 0.0, not NaN
+    assert _Peer(now).window_avgs_ms(now) == \
+        {"1min": 0.0, "5min": 0.0, "15min": 0.0}
+
+
+# -- unit: the telemetry net roll-up ----------------------------------
+
+def _msgr_perf(stall_s, wait_buckets, lat_buckets, ctl_buckets):
+    return {"msgr.osd.0": {
+        "send_stall_time": stall_s,
+        "send_stalls": 1,
+        "dispatch_wait_data": {"buckets": wait_buckets,
+                               "min": 1e-6},
+        "dispatch_lat_data": {"buckets": lat_buckets, "min": 1e-6},
+        "dispatch_lat_ctl": {"buckets": ctl_buckets, "min": 1e-6},
+    }}
+
+
+def test_net_summary_shares_p99_and_slow_peers():
+    cur = {"ts": 10.0, "unreachable": [], "daemons": {
+        "osd.0": {"perf": _msgr_perf(2.0, [0, 100], [0, 100],
+                                     [50]),
+                  "network": {"entries": [
+                      {"peer": 1, "worst_ms": 80.0},
+                      {"peer": 2, "worst_ms": 15.0}]}},
+        "osd.1": {"perf": _msgr_perf(0.0, [100], [100], [0])},
+    }}
+    s = telemetry.net_summary(cur, dt=10.0)
+    assert s["dt_s"] == 10.0
+    assert s["send_stall_s"] == pytest.approx(2.0)
+    # normalized per daemon: 2 stalled seconds / (10s * 2 daemons)
+    assert s["send_stall_share"] == pytest.approx(0.1)
+    d0 = s["per_daemon"]["osd.0"]
+    assert d0["send_stall_share"] == pytest.approx(0.2)
+    assert d0["dispatch_wait_p99_ms"] > 0
+    assert d0["ctl_per_s"] == pytest.approx(5.0)
+    assert d0["data_per_s"] == pytest.approx(10.0)
+    # osd.1's ops all landed in bucket 0 (<= 1us): p99 is the bucket
+    # edge, far below osd.0's bucket-1 edge
+    assert s["per_daemon"]["osd.1"]["dispatch_p99_ms"] < \
+        d0["dispatch_p99_ms"]
+    # the heartbeat dump's entries surface worst first with the
+    # observing daemon attributed
+    assert [e["peer"] for e in s["slow_peers"]] == [1, 2]
+    assert s["slow_peers"][0]["daemon"] == "osd.0"
+    # and the rendered table carries the headline + the peer line
+    view = telemetry.net_view(cur, dt=10.0)
+    assert "stall%" in view and "osd.0" in view
+    assert "slow heartbeat peers" in view
+
+
+def test_hist_quantile_upper_edge():
+    # 10 samples <= 1us, 0 in (1,2]us, 2 in (2,4]us: p50 is the
+    # first bucket's edge, p99 the third's
+    buckets = [10.0, 0.0, 2.0]
+    assert telemetry.hist_quantile(buckets, 1e-6, 0.5) == \
+        pytest.approx(1e-6)
+    assert telemetry.hist_quantile(buckets, 1e-6, 0.99) == \
+        pytest.approx(4e-6)
+    assert telemetry.hist_quantile([], 1e-6, 0.99) == 0.0
+    assert telemetry.hist_quantile([0.0, 0.0], 1e-6, 0.99) == 0.0
+
+
+# -- acceptance: the load-stall drill ---------------------------------
+
+def test_saturation_drill_slow_ops_raise_and_clear():
+    """ONE throttled OSD under cluster write load: its messenger
+    books the stall, the monitor raises SLOW_OPS naming it and
+    OSD_SLOW_PING_TIME for its ping lag, dump_osd_network lists the
+    slow peer worst first — and everything clears to HEALTH_OK once
+    the throttle lifts."""
+    conf = Config()
+    conf.set("osd_op_complaint_time", 0.2)
+    conf.set("osd_heartbeat_interval", 0.2)
+    conf.set("osd_heartbeat_ping_threshold_ms", 20.0)
+    cluster = MiniCluster(n_osds=3, config=conf).start()
+    try:
+        cluster.create_replicated_pool(1, pg_num=8, size=3)
+        cluster.wait_for_health_ok()
+        c = cluster.client("satdrill")
+        stop = threading.Event()
+
+        def _writes():
+            i = 0
+            while not stop.is_set():
+                try:
+                    c.put(1, f"sat-{i % 16}", b"s" * 4096)
+                except Exception:
+                    time.sleep(0.05)
+                i += 1
+
+        writer = threading.Thread(target=_writes, daemon=True)
+        writer.start()
+        # osd.1 is the saturated daemon: every op sleeps past the
+        # complaint time, every frame it SENDS drags 40ms (so its
+        # ping replies and its own pings both carry the lag)
+        cluster.set_faults(
+            "osd.slow_op=p:1.0,delay:0.5,who:osd.1;"
+            "msgr.delay_frame=p:1.0,delay:0.04,who:osd.1")
+        try:
+            deadline = time.monotonic() + 30.0
+            seen = set()
+            while time.monotonic() < deadline:
+                h = cluster.health()
+                seen = set(h.get("check_codes", []))
+                if {"SLOW_OPS", "OSD_SLOW_PING_TIME"} <= seen:
+                    break
+                time.sleep(0.3)
+            assert {"SLOW_OPS", "OSD_SLOW_PING_TIME"} <= seen, seen
+            checks = {ck.split(":", 1)[0]: ck
+                      for ck in h.get("checks", [])}
+            # per-daemon attribution: the check names the throttled
+            # daemon, not just a count
+            assert "osd.1" in checks["SLOW_OPS"]
+            assert "slow ops" in checks["SLOW_OPS"]
+            assert "ms" in checks["OSD_SLOW_PING_TIME"]
+
+            # dump_messenger (admin socket): the stall books on the
+            # throttled daemon's messenger, not on a healthy one's
+            dm1 = AdminSocket.request(
+                os.path.join(cluster.asok_dir, "osd.1.asok"),
+                "dump_messenger")
+            dm0 = AdminSocket.request(
+                os.path.join(cluster.asok_dir, "osd.0.asok"),
+                "dump_messenger")
+            s1 = dm1["totals"]["send_stall_s"]
+            s0 = dm0["totals"]["send_stall_s"]
+            assert s1 > 0.05, dm1["totals"]
+            assert s1 > 2 * s0, (s1, s0)
+            # connections come worst first and carry the lane books
+            assert dm1["connections"], dm1
+            assert dm1["connections"][0]["send_stall_s"] >= \
+                dm1["connections"][-1]["send_stall_s"]
+
+            # the cluster net roll-up sees the same skew, and the
+            # throttled daemon's dispatch-wait p99 is live
+            snap = telemetry.cluster_snapshot(cluster.asok_dir)
+            net = telemetry.net_summary(snap, dt=5.0)
+            per = net["per_daemon"]
+            assert per["osd.1"]["send_stall_s"] > \
+                2 * per["osd.0"]["send_stall_s"]
+            assert per["osd.1"]["dispatch_wait_p99_ms"] > 0
+            assert any(e["peer"] == 1 for e in net["slow_peers"])
+
+            # dump_osd_network from a HEALTHY daemon: the throttled
+            # peer breaches the threshold and sorts worst first
+            dn = AdminSocket.request(
+                os.path.join(cluster.asok_dir, "osd.0.asok"),
+                "dump_osd_network")
+            assert dn["threshold_ms"] == 20.0
+            assert dn["entries"], dn
+            assert dn["entries"][0]["peer"] == 1
+            assert dn["entries"][0]["worst_ms"] >= 20.0
+            assert {"1min", "5min", "15min"} <= \
+                set(dn["entries"][0])
+            # threshold 0 lists every peer, still worst first
+            dn_all = AdminSocket.request(
+                os.path.join(cluster.asok_dir, "osd.0.asok"),
+                "dump_osd_network", threshold_ms=0)
+            assert dn_all["total_peers"] == len(dn_all["entries"]) \
+                == 2
+            worsts = [e["worst_ms"] for e in dn_all["entries"]]
+            assert worsts == sorted(worsts, reverse=True)
+        finally:
+            cluster.set_faults("")
+            stop.set()
+            writer.join(timeout=5.0)
+        # heal: in-flight ops drain, RTT windows decay below the
+        # threshold as fresh fast samples land, checks clear
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            h = cluster.health()
+            if h.get("status") == "HEALTH_OK":
+                break
+            time.sleep(0.5)
+        assert h.get("status") == "HEALTH_OK", h
+        assert not h.get("check_codes")
+    finally:
+        cluster.shutdown()

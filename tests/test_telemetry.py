@@ -158,6 +158,73 @@ def test_prometheus_label_escaping_and_name_sanitization():
     assert "ceph_tpu_t_sum" in text and "ceph_tpu_t_count" in text
 
 
+def test_prometheus_hostile_label_escape_roundtrip():
+    """Each escape in isolation: backslash, double-quote, newline —
+    a scrape line must never carry a raw newline or an unescaped
+    quote inside a label value (the PR-17 net plane labels daemon
+    names straight from user-chosen client names)."""
+    snap = {"ts": 0, "unreachable": [], "daemons": {
+        'back\\slash': {"perf": {"client.a": {"ops_put": 1}}},
+        'quo"te': {"perf": {"client.b": {"ops_put": 2}}},
+        'new\nline': {"perf": {"client.c": {"ops_put": 3}}},
+    }}
+    text = telemetry.to_prometheus(snap)
+    _validate_exposition(text)
+    assert 'daemon="back\\\\slash"' in text
+    assert 'daemon="quo\\"te"' in text
+    assert 'daemon="new\\nline"' in text
+    # the raw newline never survives: every sample stays one line
+    assert text.count("\n") == len(text.splitlines())
+
+
+def test_prometheus_empty_histogram_emits_count_zero():
+    """A declared-but-never-booked histogram still scrapes: all-zero
+    buckets emit the full cumulative ladder and ``_count 0`` — the
+    series EXISTS at zero, so dashboards and absent() alerts can tell
+    'idle' from 'never exported' (the drift OBS003 red-flags)."""
+    snap = _snap({"msgr.osd.0": {
+        "dispatch_wait_ctl": {"buckets": [0, 0, 0], "min": 1e-6}}})
+    text = telemetry.to_prometheus(snap)
+    _validate_exposition(text)
+    assert "# TYPE ceph_tpu_dispatch_wait_ctl histogram" in text
+    assert ('ceph_tpu_dispatch_wait_ctl_bucket{daemon="osd.0",'
+            'logger="msgr.osd.0",le="+Inf"} 0') in text
+    assert ('ceph_tpu_dispatch_wait_ctl_count{daemon="osd.0",'
+            'logger="msgr.osd.0"} 0') in text
+
+
+def test_prometheus_bucket_monotonicity():
+    """Cumulative histogram invariants: bucket values non-decreasing
+    in le order, +Inf present exactly once per series and equal to
+    _count."""
+    import re
+
+    snap = _snap({"msgr.osd.0": {
+        "send_queue_depth": {"buckets": [3, 0, 5, 0, 2, 1],
+                             "min": 1.0}}})
+    text = telemetry.to_prometheus(snap)
+    _validate_exposition(text)
+    pairs = []
+    inf = None
+    for line in text.splitlines():
+        m = re.match(r'^ceph_tpu_send_queue_depth_bucket\{.*'
+                     r'le="([^"]+)"\} (\d+)$', line)
+        if m:
+            if m.group(1) == "+Inf":
+                assert inf is None, "duplicate +Inf bucket"
+                inf = int(m.group(2))
+            else:
+                pairs.append((float(m.group(1)), int(m.group(2))))
+    assert len(pairs) == 6 and inf is not None
+    assert pairs == sorted(pairs)  # le ascending as emitted
+    counts = [c for _le, c in pairs]
+    assert counts == sorted(counts)  # cumulative: non-decreasing
+    assert counts[-1] == inf == 11  # +Inf carries the total
+    m = re.search(r"^ceph_tpu_send_queue_depth_count\{.*\} (\d+)$",
+                  text, re.M)
+    assert m and int(m.group(1)) == 11
+
+
 # -- unit: trace reassembly --------------------------------------------------
 
 def _span(sid, parent, name, service, start, trace="t1"):
@@ -497,7 +564,8 @@ def test_profile_admin_verb_and_flame(ec_cluster):
 
 def test_daemonperf_derived_columns(ec_cluster):
     """daemonperf satellite: the cp/op (copied bytes per served op),
-    unattr%, and hb lat columns ride the derived view."""
+    unattr%, hb lat, and the PR-17 saturation pair (stall%, dq p99)
+    ride the derived view."""
     c = ec_cluster.client("dpd")
     c.put(2, "dpd-warm", b"w" * 512)  # daemon present in BOTH snaps
     prev = telemetry.cluster_snapshot(ec_cluster.asok_dir)
@@ -506,19 +574,27 @@ def test_daemonperf_derived_columns(ec_cluster):
     time.sleep(0.05)
     cur = telemetry.cluster_snapshot(ec_cluster.asok_dir)
     view = telemetry.daemonperf_view(prev, cur)
-    # "hb lat" whitespace-splits into two header tokens but one cell
-    assert view.splitlines()[0].split()[-4:] == \
-        ["cp/op", "unattr%", "hb", "lat"]
+    # "hb lat" / "dq p99" whitespace-split into two header tokens
+    # each but one cell each
+    assert view.splitlines()[0].split()[-7:] == \
+        ["cp/op", "unattr%", "hb", "lat", "stall%", "dq", "p99"]
     rows = {ln.split()[0]: ln.split()
             for ln in view.splitlines()[1:]}
     # the derived columns are LAST — parse from the end: a saturated
     # rate cell earlier in the row can overflow its width and merge
     # with its neighbor, shifting index-from-header addressing
-    cp = rows["client.dpd"][-3]
+    cp = rows["client.dpd"][-5]
     assert cp != "-" and float(cp) > 0
     # a client has no osd.hb.* loggers: its hb lat cell stays dark
-    assert rows["client.dpd"][-1] == "-"
+    assert rows["client.dpd"][-3] == "-"
+    # stall% always renders (an idle window is a true 0.0%); dq p99
+    # needs dispatch traffic in the window — the OSDs served the puts
+    assert rows["client.dpd"][-2].endswith("%")
+    osd_row = rows["osd.0"]
+    assert osd_row[-2].endswith("%")
+    assert osd_row[-1] != "-" and float(osd_row[-1]) >= 0.0
     # derived=False restores the legacy schema
     legacy = telemetry.daemonperf_view(prev, cur, derived=False)
     assert "cp/op" not in legacy.splitlines()[0]
     assert "hb" not in legacy.splitlines()[0].split()
+    assert "stall%" not in legacy.splitlines()[0].split()

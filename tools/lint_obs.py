@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Observability lint — static companion to the counter registry.
 
-Two rules, enforced by tests/test_lint.py like the CONC/JAX/WIRE
+The rules, enforced by tests/test_lint.py like the CONC/JAX/WIRE
 families:
 
 OBS001  a perf-counter declaration (``add_u64_counter``/``add_u64``/
@@ -35,6 +35,21 @@ OBS002  the continuous-profiling plane must stay in sync with the
             every op in production.  Gate it (as Context's admin hook
             does behind ``if sub == "start":``) or add
             ``# obs-ok: <reason>``.
+
+OBS003  every counter name in the registry must round-trip through
+        the prometheus exporter: a synthetic snapshot carrying one
+        daemon with EVERY registered counter (dumped in its type's
+        wire shape — plain number for u64/gauge/time, ``{avgcount,
+        sum}`` for avg, ``{buckets, min}`` for hist) is fed to
+        ``telemetry.to_prometheus`` and every name's sanitized metric
+        family (``ceph_tpu_<name>``; for histograms the ``_bucket``/
+        ``_count`` series under it) must come back with a ``# HELP``
+        header.  A registered-but-unexported counter is the scrape-
+        side twin of OBS001's drift: the daemon books it, daemonperf
+        can read it, and the prometheus surface silently never shows
+        it.  Also fails on a sanitization COLLISION that merges two
+        registered names of different types into one family — the
+        exporter would emit conflicting ``# TYPE`` claims.
 
 COPY001 a ``bytes(...)`` (single-argument) or ``.tobytes()`` call in a
         hot-path data-plane module (``msg/``, ``os/``,
@@ -321,6 +336,56 @@ def lint_registry_sync() -> List[Violation]:
     return out
 
 
+def lint_prometheus_export() -> List[Violation]:
+    """OBS003: every registered counter must surface on the
+    prometheus scrape.  Build a synthetic one-daemon snapshot whose
+    perf dump carries EVERY registry counter in its type's dump
+    shape, run it through the real exporter, and demand each name's
+    sanitized family HELP header back — plus no cross-type family
+    collision from sanitization."""
+    from ceph_tpu.common.counters import (AVG, HIST,  # noqa: E402
+                                          REGISTRY)
+    from ceph_tpu.tools.telemetry import (_sanitize,  # noqa: E402
+                                          to_prometheus)
+    perf: dict = {}
+    for family, names in REGISTRY.items():
+        perf[family] = {}
+        for name, typ in names.items():
+            if typ == HIST:
+                perf[family][name] = {"buckets": [1, 2], "min": 1e-6}
+            elif typ == AVG:
+                perf[family][name] = {"avgcount": 1, "sum": 1.0,
+                                      "avg": 1.0}
+            else:
+                perf[family][name] = 1
+    text = to_prometheus(
+        {"daemons": {"lint.0": {"perf": perf}}})
+    helped = {line.split()[2] for line in text.splitlines()
+              if line.startswith("# HELP ")}
+    out: List[Violation] = []
+    metric_types: dict = {}
+    for family, names in sorted(REGISTRY.items()):
+        for name, typ in sorted(names.items()):
+            metric = f"ceph_tpu_{_sanitize(name)}"
+            prev = metric_types.setdefault(metric, (family, name,
+                                                    typ))
+            if prev[2] != typ:
+                out.append(Violation(
+                    "OBS003", "ceph_tpu/common/counters.py", 0,
+                    f"sanitized family {metric!r} merges "
+                    f"{prev[0]}/{prev[1]} ({prev[2]}) with "
+                    f"{family}/{name} ({typ}) — the exporter would "
+                    f"emit conflicting # TYPE claims"))
+            if metric not in helped:
+                out.append(Violation(
+                    "OBS003", "ceph_tpu/common/counters.py", 0,
+                    f"registered counter {family}/{name} ({typ}) is "
+                    f"not exported by telemetry.to_prometheus — no "
+                    f"'# HELP {metric}' in the scrape of a snapshot "
+                    f"that books it"))
+    return out
+
+
 def lint_paths(paths: Iterable) -> List[Violation]:
     out: List[Violation] = []
     for p in paths:
@@ -338,7 +403,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = argv if argv is not None else sys.argv[1:]
     roots = args or [pathlib.Path(__file__).resolve().parent.parent
                      / "ceph_tpu"]
-    violations = lint_registry_sync() + lint_paths(roots)
+    violations = lint_registry_sync() + lint_prometheus_export() \
+        + lint_paths(roots)
     for v in violations:
         print(v)
     return 1 if violations else 0

@@ -68,6 +68,8 @@ METRICS = [
     ("attr_unattr_pct", False),
     ("copy_bytes_per_op", False),
     ("prof_overhead_pct", False),
+    ("net.send_stall_share", False),
+    ("net.dispatch_p99_ms", False),
 ]
 
 _TAIL_PATTERNS = {
@@ -161,6 +163,15 @@ def _profiling_metrics(tail: str) -> Dict[str, float]:
     prof = d.get("profiler") or {}
     if isinstance(prof.get("overhead_pct"), (int, float)):
         out["prof_overhead_pct"] = float(prof["overhead_pct"])
+    # saturation plane (PR 17): whole-run messenger backpressure —
+    # stall share creeping up means the send path is blocking on the
+    # wire; dispatch p99 creeping up means frames are sitting in the
+    # handler pool queue before any handler runs
+    net = d.get("net") or {}
+    if isinstance(net.get("send_stall_share"), (int, float)):
+        out["net.send_stall_share"] = float(net["send_stall_share"])
+    if isinstance(net.get("dispatch_p99_ms"), (int, float)):
+        out["net.dispatch_p99_ms"] = float(net["dispatch_p99_ms"])
     return out
 
 

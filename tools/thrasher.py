@@ -50,6 +50,14 @@ trip the ``osd_markdown_log`` dampener, raise OSD_FLAPPING, and stop
 the epoch churn.  The ``NETSPLIT_rNN.json`` record's
 ``false_markdowns`` / ``detect_s`` / ``epoch_churn`` columns are
 red-checked by tools/perf_history.py.
+
+``--slow-ops`` runs the SLO-escalation drill instead: one OSD is
+throttled (every op past ``osd_op_complaint_time``, every sent frame
+dragged) under write load — SLOW_OPS must rise naming the victim,
+OSD_SLOW_PING_TIME must rise from its ping lag, the send stall must
+book on the victim's messenger only, and once the throttle lifts the
+cluster must clear to HEALTH_OK with zero acked-write loss (emits
+``SLODRILL_rNN.json``).
 """
 
 from __future__ import annotations
@@ -73,6 +81,7 @@ if _ROOT not in sys.path:
 
 from ceph_tpu.analysis import faults, lockdep  # noqa: E402
 from ceph_tpu.common import tracing  # noqa: E402
+from ceph_tpu.common.admin_socket import AdminSocket  # noqa: E402
 from ceph_tpu.common.backoff import Backoff  # noqa: E402
 from ceph_tpu.common.config import Config  # noqa: E402
 from ceph_tpu.services.client import ObjectNotFound  # noqa: E402
@@ -833,6 +842,94 @@ def _flap_phase(seed: int, n_osds: int = 4,
     return out
 
 
+def slow_ops_drill(seed: int = 8, n_osds: int = 3) -> Dict:
+    """The SLO-escalation drill (``--slow-ops``): ONE throttled OSD
+    under cluster write load.  Every op on the victim sleeps past
+    ``osd_op_complaint_time`` and every frame it sends drags against
+    the ``msgr.delay_frame`` failpoint, so the drill must see the
+    whole saturation plane fire: SLOW_OPS naming the victim and
+    OSD_SLOW_PING_TIME raised by the monitor, the send stall booked
+    on the victim's messenger (``dump_messenger`` over the admin
+    socket) and NOT on a healthy peer's — then, once the throttle
+    lifts, in-flight ops drain, the RTT windows decay, and health
+    returns to HEALTH_OK with zero acked-write loss."""
+    rng = random.Random(seed)
+    faults.reset()
+    faults.seed(seed)
+    conf = _conf()
+    conf.set("osd_op_complaint_time", 0.2)
+    conf.set("osd_heartbeat_ping_threshold_ms", 20.0)
+    c = MiniCluster(n_osds=n_osds, config=conf).start()
+    out: Dict = {"kind": "slowops", "seed": seed,
+                 "n_osds": n_osds}
+    try:
+        c.create_replicated_pool(1, pg_num=8, size=3)
+        c.wait_for_health_ok()
+        w = _Writer(c, 0, 1, ec=False)
+        w.start()
+        victim = rng.randrange(n_osds)
+        out["victim"] = victim
+        t0 = time.monotonic()
+        c.set_faults(
+            f"osd.slow_op=p:1.0,delay:0.5,who:osd.{victim};"
+            f"msgr.delay_frame=p:1.0,delay:0.04,who:osd.{victim}")
+        codes: set = set()
+        h: Dict = {}
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            h = c.health()
+            codes = set(h.get("check_codes", []))
+            if {"SLOW_OPS", "OSD_SLOW_PING_TIME"} <= codes:
+                break
+            time.sleep(0.25)
+        out["raise_s"] = round(time.monotonic() - t0, 2)
+        out["slow_ops_raised"] = "SLOW_OPS" in codes
+        out["ping_time_raised"] = "OSD_SLOW_PING_TIME" in codes
+        checks = {ck.split(":", 1)[0]: ck
+                  for ck in h.get("checks", [])}
+        out["named_victim"] = \
+            f"osd.{victim}" in checks.get("SLOW_OPS", "")
+        # admin-socket proof the telemetry attributes the stall to
+        # the right daemon, not just that health went red
+        dm_v = AdminSocket.request(
+            os.path.join(c.asok_dir, f"osd.{victim}.asok"),
+            "dump_messenger")
+        dm_h = AdminSocket.request(
+            os.path.join(c.asok_dir,
+                         f"osd.{(victim + 1) % n_osds}.asok"),
+            "dump_messenger")
+        out["victim_stall_s"] = dm_v["totals"]["send_stall_s"]
+        out["healthy_stall_s"] = dm_h["totals"]["send_stall_s"]
+        c.set_faults("")
+        w.stop.set()
+        w.join(timeout=20)
+        bad = _verify(c, [w])
+        out["checked"] = len(w.acked)
+        out["lost"] = len(bad)
+        t1 = time.monotonic()
+        cleared = False
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            h = c.health()
+            if h.get("status") == "HEALTH_OK":
+                cleared = True
+                break
+            time.sleep(0.5)
+        out["cleared"] = cleared
+        out["clear_s"] = round(time.monotonic() - t1, 2)
+        out["ok"] = bool(out["slow_ops_raised"]
+                         and out["ping_time_raised"]
+                         and out["named_victim"]
+                         and out["victim_stall_s"]
+                         > 2 * out["healthy_stall_s"]
+                         and out["lost"] == 0
+                         and out["cleared"])
+    finally:
+        c.shutdown()
+        faults.reset()
+    return out
+
+
 def netsplit(seed: int = 8) -> Dict:
     """The full NETSPLIT record: mon-link cut (no false markdowns),
     full isolation (fast true-positive detection, zero acked loss),
@@ -888,6 +985,12 @@ def main(argv=None) -> int:
                          "drills (mon-link cut, full isolation, "
                          "flapping link) instead of the chaos soak "
                          "(emits NETSPLIT_rNN.json)")
+    ap.add_argument("--slow-ops", action="store_true",
+                    help="run the SLO-escalation drill (one "
+                         "throttled OSD must raise SLOW_OPS + "
+                         "OSD_SLOW_PING_TIME and clear to "
+                         "HEALTH_OK) instead of the chaos soak "
+                         "(emits SLODRILL_rNN.json)")
     ap.add_argument("--slo-p99-ms", type=float, default=250.0,
                     help="degraded-read soak p99 SLO in ms "
                          "(default 250)")
@@ -898,7 +1001,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     series = "DRILL" if args.host_kill else \
-        "NETSPLIT" if args.netsplit else "CHAOS"
+        "NETSPLIT" if args.netsplit else \
+        "SLODRILL" if args.slow_ops else "CHAOS"
     out = args.out
     if out is None:
         n = next_run_number(_ROOT)
@@ -908,6 +1012,8 @@ def main(argv=None) -> int:
         rec = drill(seed=args.seed, slo_p99_ms=args.slo_p99_ms)
     elif args.netsplit:
         rec = netsplit(seed=args.seed)
+    elif args.slow_ops:
+        rec = slow_ops_drill(seed=args.seed)
     else:
         rec = soak(seed=args.seed, duration=args.duration,
                    n_osds=args.osds, n_mons=args.mons,
@@ -916,7 +1022,15 @@ def main(argv=None) -> int:
     with open(out, "w") as f:
         json.dump(rec, f, indent=1)
         f.write("\n")
-    if args.netsplit:
+    if args.slow_ops:
+        print(f"# slowops seed={rec['seed']} victim=osd."
+              f"{rec.get('victim')} raise={rec.get('raise_s')}s "
+              f"stall={rec.get('victim_stall_s')}s "
+              f"(healthy {rec.get('healthy_stall_s')}s) "
+              f"clear={rec.get('clear_s')}s "
+              f"lost={rec.get('lost')}/{rec.get('checked')} -> "
+              f"{'OK' if rec['ok'] else 'FAIL'} ({out})")
+    elif args.netsplit:
         print(f"# netsplit seed={rec['seed']} "
               f"false_markdowns={rec.get('false_markdowns')} "
               f"detect={rec.get('detect_s')}s "
