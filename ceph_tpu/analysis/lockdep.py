@@ -57,14 +57,32 @@ _reported: set = set()
 _violations: List[Dict] = []
 # (thread id, id(lock)) -> {"name", "thread", "since", "depth"}
 _held_registry: Dict[Tuple[int, int], Dict] = {}
+# same key -> the ACQUIRING thread's _tls.held list object, so a
+# release on a DIFFERENT thread (a ``with lock:`` suspended inside a
+# generator and closed elsewhere, a callback handed across threads)
+# can scrub the acquirer's stale entry instead of leaving a phantom
+# hold that poisons its next order edge and the stall watchdog
+_holder_lists: Dict[Tuple[int, int], list] = {}
+
+# per-thread frozenset of held lock NAMES, rebuilt lazily on demand
+# and invalidated on every acquire/release touching that thread's
+# held list (including foreign scrubs) — racecheck consults the held
+# set on EVERY guarded attribute access, so this must not rebuild a
+# frozenset per access
+_held_names_cache: Dict[int, frozenset] = {}
 
 _tls = threading.local()
+
+# the env is read once: every entry point (conftest, thrasher, the
+# daemons) sets it before importing ceph_tpu, and enable() overrides
+# it at runtime
+_env_on = os.environ.get(ENV, "") not in ("", "0", "false", "no")
 
 
 def enabled() -> bool:
     if _forced is not None:
         return _forced
-    return os.environ.get(ENV, "") not in ("", "0", "false", "no")
+    return _env_on
 
 
 def enable(on: bool = True) -> None:
@@ -130,6 +148,19 @@ def _held() -> list:
     if st is None:
         st = _tls.held = []
     return st
+
+
+def held_names() -> frozenset:
+    """Frozenset of lock names the calling thread holds, cached per
+    thread between acquire/release events (racecheck's hot read)."""
+    tid = threading.get_ident()
+    v = _held_names_cache.get(tid)
+    if v is None:
+        v = frozenset(n for n, _ in _held())
+        if len(_held_names_cache) > 512:  # dead-thread hygiene
+            _held_names_cache.clear()
+        _held_names_cache[tid] = v
+    return v
 
 
 def _stack() -> str:
@@ -226,8 +257,11 @@ def _will_lock(lk, certain_block: bool) -> None:
 
 
 def _locked(lk) -> None:
-    _held().append((lk._name, lk))
-    key = (threading.get_ident(), id(lk))
+    held = _held()
+    held.append((lk._name, lk))
+    tid = threading.get_ident()
+    _held_names_cache.pop(tid, None)
+    key = (tid, id(lk))
     with _state:
         info = _held_registry.get(key)
         if info is None:
@@ -235,6 +269,7 @@ def _locked(lk) -> None:
                 "name": lk._name,
                 "thread": threading.current_thread().name,
                 "since": time.monotonic(), "depth": 1}
+            _holder_lists[key] = held
         else:
             info["depth"] += 1
 
@@ -247,15 +282,46 @@ def _released(lk) -> int:
             del held[i]
             break
     else:
-        return 0
-    key = (threading.get_ident(), id(lk))
+        return _released_foreign(lk)
+    tid = threading.get_ident()
+    _held_names_cache.pop(tid, None)
+    key = (tid, id(lk))
     with _state:
         info = _held_registry.get(key)
         if info is not None:
             info["depth"] -= 1
             if info["depth"] <= 0:
                 del _held_registry[key]
+                _holder_lists.pop(key, None)
     return 1
+
+
+def _released_foreign(lk) -> int:
+    """Release attributed to the wrong thread: the acquire ran
+    elsewhere (a ``with lock:`` suspended in a generator and resumed
+    on another thread, a registered callback).  Without this, the
+    acquiring thread keeps a phantom entry in its held-set — every
+    later acquisition there records a false order edge, and the
+    watchdog reports a lock nobody holds.  Scrub the acquirer's
+    bookkeeping by the lock's identity instead."""
+    with _state:
+        for key in list(_held_registry):
+            if key[1] != id(lk):
+                continue
+            info = _held_registry[key]
+            info["depth"] -= 1
+            lst = _holder_lists.get(key)
+            if lst is not None:
+                for i in range(len(lst) - 1, -1, -1):
+                    if lst[i][1] is lk:
+                        del lst[i]
+                        break
+                _held_names_cache.pop(key[0], None)
+            if info["depth"] <= 0:
+                del _held_registry[key]
+                _holder_lists.pop(key, None)
+            return 1
+    return 0
 
 
 def _released_all(lk) -> int:

@@ -37,6 +37,7 @@ from typing import Dict, List, Optional, Tuple
 import weakref
 
 from ..analysis.lockdep import make_lock
+from ..analysis.racecheck import guarded_by, shared
 from .perf_counters import PerfCounters, collection
 
 LOGGER = "obs.bufpool"
@@ -105,12 +106,14 @@ class Segment:
         return self._refs
 
 
+@guarded_by("bufpool::pool", "_live")
 class BufferPool:
     """Per-size-class recycling pool (process-global via ``pool()``)."""
 
     def __init__(self, per_class: int = _PER_CLASS):
         self._lock = make_lock("bufpool::pool")
-        self._free: Dict[int, List[bytearray]] = {}
+        self._free: Dict[int, List[bytearray]] = shared(
+            {}, "bufpool::pool", "bufpool.free")
         self._per_class = per_class
         # live-segment registry for the per-test leak gate: id -> tag
         self._live: Dict[int, Tuple[str, int]] = {}

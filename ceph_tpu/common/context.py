@@ -92,6 +92,15 @@ class Context:
             from ..analysis import faults
 
             faults.wire(self._admin)
+            # the data-race checker surface (analysis/racecheck.py):
+            # guarded-class registry + recorded violations with both
+            # access stacks, beside lockdep's dump_blocked
+            from ..analysis import racecheck
+
+            self._admin.register(
+                "dump_racecheck", lambda _a: racecheck.dump(),
+                "data-race checker: guarded classes and recorded "
+                "lockset/confinement violations (both stacks)")
             self._admin.start()
             # a daemon with an admin plane gets the stall watchdog
             # behind it: dump_blocked serves on demand, the scanner

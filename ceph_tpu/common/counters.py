@@ -244,6 +244,15 @@ REGISTRY: Dict[str, Dict[str, str]] = {
         "unattributed": HIST,
         "attributed_ops": U64,
     },
+    # the data-race checker (analysis/racecheck.py): violation count
+    # (normally 0 — the daemonperf `race` column and the --race-audit
+    # gate read it) plus registry-size gauges
+    "analysis.race": {
+        "violations": U64,
+        "guarded_classes": GAUGE,
+        "guarded_fields": GAUGE,
+        "shared_objects": GAUGE,
+    },
 }
 
 

@@ -29,10 +29,13 @@ import time
 from typing import Deque, Dict, List, Optional
 
 from ..analysis.lockdep import make_lock
+from ..analysis.racecheck import guarded_by
 from . import device_metrics
 from .perf_counters import PerfCountersCollection, collection
 
 
+@guarded_by("metrics::history", "_ring",
+            owned_by_thread=("sample_errors", "last_error"))
 class MetricsHistory:
     def __init__(self, name: str,
                  perf: Optional[PerfCountersCollection] = None,

@@ -15,6 +15,7 @@ import time
 from typing import Deque, Dict, List, Optional
 
 from ..analysis.lockdep import make_lock
+from ..analysis.racecheck import guarded_by
 
 
 class TrackedOp:
@@ -58,6 +59,7 @@ class TrackedOp:
                            for t, e in self.events]}
 
 
+@guarded_by("optracker", "_inflight", "_history", "_slow", "_served")
 class OpTracker:
     def __init__(self, history_size: int = 20,
                  history_slow_threshold: float = 0.5,

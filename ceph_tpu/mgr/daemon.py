@@ -34,6 +34,7 @@ import time
 from typing import Dict, Optional
 
 from ..analysis.lockdep import make_rlock
+from ..analysis.racecheck import guarded_by
 from ..common.backoff import Backoff
 from ..common.context import Context
 from ..msg.messenger import Addr, Messenger
@@ -88,6 +89,22 @@ def module_registry() -> Dict[str, type]:
     return {BalancerModule.NAME: BalancerModule}
 
 
+@guarded_by("mgr::state", "due", "bo", "error")
+class _ModuleSched:
+    """Per-module scheduler state: the next-due stamp, the jittered
+    backoff series of a failing module, and its last error.  Written
+    by the tick thread AND the admin-socket handlers (module
+    enable/disable re-arms), so every access runs under the mgr state
+    lock — the unlocked tick-loop writes this replaced were the race
+    the checker's empty-lockset report flagged."""
+
+    def __init__(self):
+        self.due = 0.0
+        self.bo: Optional[Backoff] = None
+        self.error: Optional[str] = None
+
+
+@guarded_by("mgr::state", "_sched")
 class MgrDaemon(MapFollower):
     """The manager daemon: map follower + module scheduler."""
 
@@ -136,9 +153,8 @@ class MgrDaemon(MapFollower):
                 if s.strip()}
         self.enabled: Dict[str, bool] = {
             name: name in want for name in self.modules}
-        self._sched: Dict[str, Dict] = {
-            name: {"due": 0.0, "bo": None, "error": None}
-            for name in self.modules}
+        self._sched: Dict[str, _ModuleSched] = {
+            name: _ModuleSched() for name in self.modules}
 
     # -- handlers ------------------------------------------------------
     def _h_map_update(self, msg):
@@ -150,7 +166,7 @@ class MgrDaemon(MapFollower):
             return {"name": self.name, "epoch": self.epoch,
                     "modules": {n: {"enabled": self.enabled[n],
                                     "last_error":
-                                        self._sched[n]["error"]}
+                                        self._sched[n].error}
                                 for n in self.modules}}
 
     def _post_map_install(self) -> None:
@@ -167,11 +183,12 @@ class MgrDaemon(MapFollower):
             "balancer status|on|off|eval|execute (balancer module)")
 
     def _module_ls(self) -> Dict:
-        return {"modules": {
-            n: {"enabled": self.enabled[n],
-                "interval": self.modules[n].interval,
-                "last_error": self._sched[n]["error"]}
-            for n in sorted(self.modules)}}
+        with self._lock:
+            return {"modules": {
+                n: {"enabled": self.enabled[n],
+                    "interval": self.modules[n].interval,
+                    "last_error": self._sched[n].error}
+                for n in sorted(self.modules)}}
 
     def _admin_mgr(self, args: Dict) -> Dict:
         argv = [str(a) for a in (args.get("argv") or [])]
@@ -187,8 +204,9 @@ class MgrDaemon(MapFollower):
                         "have": sorted(self.modules)}
             self.enabled[name] = argv[1] == "enable"
             if self.enabled[name]:
-                st = self._sched[name]
-                st["due"], st["bo"], st["error"] = 0.0, None, None
+                with self._lock:
+                    st = self._sched[name]
+                    st.due, st.bo, st.error = 0.0, None, None
             self._wake.set()
             return {"success": f"module {name} "
                                f"{'enabled' if self.enabled[name] else 'disabled'}"}
@@ -206,10 +224,13 @@ class MgrDaemon(MapFollower):
     # -- scheduler -----------------------------------------------------
     def _health_report(self) -> Dict[str, str]:
         checks: Dict[str, str] = {}
-        for name, st in self._sched.items():
-            if self.enabled.get(name) and st["error"]:
+        with self._lock:
+            errors = {name: st.error
+                      for name, st in self._sched.items()}
+        for name, err in errors.items():
+            if self.enabled.get(name) and err:
                 checks["MGR_MODULE_ERROR"] = \
-                    f"module {name} failed: {st['error']}"
+                    f"module {name} failed: {err}"
         for name, mod in self.modules.items():
             if not self.enabled.get(name):
                 continue
@@ -233,34 +254,38 @@ class MgrDaemon(MapFollower):
             for name, mod in self.modules.items():
                 if not self._running or not self.enabled.get(name):
                     continue
-                st = self._sched[name]
-                if now < st["due"]:
+                with self._lock:
+                    st = self._sched[name]
+                    due = st.due
+                if now < due:
                     continue
                 try:
                     self.pc.inc("module_runs")
-                    mod.tick()
+                    mod.tick()  # never under the state lock
                 except Exception as e:
                     self.pc.inc("module_errors")
-                    st["error"] = repr(e)
-                    if st["bo"] is None:
-                        # keep drawing from one decorrelated series
-                        # across consecutive failures: the re-arm
-                        # delay grows jittered toward the cap
-                        st["bo"] = Backoff(base=mod.interval,
-                                           cap=mod.interval * 8)
-                    st["due"] = time.monotonic() + \
-                        st["bo"].next_interval()
+                    with self._lock:
+                        st.error = repr(e)
+                        if st.bo is None:
+                            # keep drawing from one decorrelated
+                            # series across consecutive failures: the
+                            # re-arm delay grows jittered to the cap
+                            st.bo = Backoff(base=mod.interval,
+                                            cap=mod.interval * 8)
+                        st.due = time.monotonic() + \
+                            st.bo.next_interval()
                     self.log.dout(1, f"module {name} tick failed: "
                                      f"{e!r}")
                 else:
-                    st["error"] = None
-                    st["bo"] = None
-                    # healthy pacing still jitters (one fresh draw):
-                    # modules desynchronize instead of all waking on
-                    # the same beat
-                    st["due"] = time.monotonic() + Backoff(
-                        base=mod.interval,
-                        cap=mod.interval * 2).next_interval()
+                    with self._lock:
+                        st.error = None
+                        st.bo = None
+                        # healthy pacing still jitters (one fresh
+                        # draw): modules desynchronize instead of all
+                        # waking on the same beat
+                        st.due = time.monotonic() + Backoff(
+                            base=mod.interval,
+                            cap=mod.interval * 2).next_interval()
             checks = self._health_report()
             if checks != last_health:
                 last_health = checks

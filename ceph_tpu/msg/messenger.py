@@ -60,6 +60,7 @@ from typing import Callable, Dict, Optional, Tuple
 from ..analysis import faults
 from ..analysis import watchdog
 from ..analysis.lockdep import make_lock, make_rlock
+from ..analysis.racecheck import guarded_by, shared
 from ..common import bufpool
 from ..common import copytrack
 from ..common.backoff import Backoff
@@ -102,7 +103,11 @@ class _SockWriter:
         self.q: "collections.deque[_SendOp]" = collections.deque()
 
 
-_sock_writers: Dict[int, _SockWriter] = {}
+# mutation-checked under racecheck: every writer-table insert/reap
+# must hold the guard; the lock-free reads in _send/dump_messenger
+# are the deliberate GIL-atomic idiom shared() leaves legal
+_sock_writers: Dict[int, _SockWriter] = shared(
+    {}, "msgr::send_guard", "msgr.sock_writers")
 _sock_writers_guard = make_lock("msgr::send_guard")
 
 # A send slower than this is socket backpressure (or an armed wire
@@ -640,6 +645,8 @@ class _InSession:
             self.replies.popitem(last=False)
 
 
+@guarded_by("msgr::conn", "_conns", "_accepted", "_conn_waiters")
+@guarded_by("msgr::pending", "_pending", "_waiters")
 class Messenger:
     def __init__(self, name: str, host: str = "127.0.0.1",
                  port: int = 0, keyring=None, lossless: bool = False,

@@ -36,6 +36,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..analysis import faults
 from ..analysis.lockdep import make_lock, make_rlock
+from ..analysis.racecheck import guarded_by
 from ..common import copytrack
 from ..common.bincode import (DecodeError, Decoder, Encoder, decode_txn,
                               encode_txn)
@@ -220,6 +221,7 @@ class _TxnWaiter:
         self.done.set()
 
 
+@guarded_by("os::wal", "_pending", "_seq")
 class WALStore(ObjectStore):
     def __init__(self, path: str, checkpoint_every_bytes: int = 1 << 24,
                  sync: bool = True, compression: str = "zlib",
@@ -504,7 +506,7 @@ class WALStore(ObjectStore):
 
     def _load_checkpoint(self) -> None:
         self._mem = MemStore(copy_coll=self._copy_coll)
-        self._seq = self._ckpt_seq = 0
+        self._seq = self._ckpt_seq = 0  # race-ok: mount-time, before any writer thread exists
         self.last_mount_error = None
         try:
             raw = open(self._ckpt_path, "rb").read()
@@ -526,7 +528,7 @@ class WALStore(ObjectStore):
             self.log.derr(f"wal: {self.last_mount_error}")
             return
         self._mem._coll = colls
-        self._seq = self._ckpt_seq = seq
+        self._seq = self._ckpt_seq = seq  # race-ok: mount-time, before any writer thread exists
 
     def _replay_wal(self) -> int:
         """Apply WAL records past the checkpoint; stop at the first
@@ -567,7 +569,7 @@ class WALStore(ObjectStore):
                 self.log.derr(f"wal: {self.last_mount_error}")
                 break
             pos = end
-            self._seq = seq
+            self._seq = seq  # race-ok: mount-time replay, single-threaded before any writer exists
         return pos
 
     # -- reads delegate to the in-memory twin -------------------------

@@ -33,6 +33,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..analysis.lockdep import make_lock
+from ..analysis.racecheck import guarded_by
 
 # EWMA smoothing for ping RTT and its weight in the effective grace:
 # eff_grace = grace + GRACE_LAT_FACTOR * ewma.  On a loopback cluster
@@ -80,6 +81,7 @@ class _Peer:
                 for i, (_span, label) in enumerate(WINDOWS)}
 
 
+@guarded_by("osd::hb", "_peers")
 class HeartbeatPlane:
     """One OSD's peer-ping plane.  Owned by OSDService: constructed
     with it (registers its two control-lane handlers), started after

@@ -30,6 +30,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 
 from ..analysis import faults
 from ..analysis.lockdep import make_lock, make_rlock
+from ..analysis.racecheck import guarded_by
 from ..common import encoding
 from ..common.context import Context
 from ..common.op_tracker import OpTracker
@@ -58,6 +59,7 @@ def decode_epoch_payload(blob) -> Dict:
     return d
 
 
+@guarded_by("mon::state", "_pg_stats", "_osd_slo", "_subscribers")
 class Monitor:
     def __init__(self, ctx: Context, osdmap: OSDMap,
                  host: str = "127.0.0.1", port: int = 0,

@@ -38,6 +38,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..analysis import faults
 from ..analysis.lockdep import make_lock, make_rlock
+from ..analysis.racecheck import guarded_by
 from ..common import copytrack
 from ..common.backoff import Backoff
 from ..common.context import Context
@@ -63,6 +64,9 @@ def pg_cid(pool_id: int, ps: int) -> str:
 from .map_follower import MapFollower
 
 
+@guarded_by("osd::state", "_pg_states", "_watchers", "_strays")
+@guarded_by("osd::pg_io", "_pg_io")
+@guarded_by("osd::pg_guard", "_pg_locks")
 class OSDService(MapFollower):
     def __init__(self, ctx: Context, osd_id: int, mon_addr: Addr,
                  host: str = "127.0.0.1", port: int = 0, keyring=None,
@@ -387,7 +391,12 @@ class OSDService(MapFollower):
                 with self._lock:
                     self._pg_states.pop((pool_id, ps), None)
                 continue
-            if m is not None and (pool_id, ps) in self._pg_states:
+            # membership check under the state lock: the unlocked
+            # read raced _h_pg_remove's pop from a dispatch thread
+            # (caught by racecheck's empty-lockset report)
+            with self._lock:
+                leads = (pool_id, ps) in self._pg_states
+            if m is not None and leads:
                 up, _p, acting, _ap = self.pg_up_acting(pool_id, ps)
                 members = acting if acting else up
                 prim = next((o for o in members if self._alive(o)),

@@ -207,6 +207,10 @@ DEFAULT_COLUMNS: List[Tuple[str, str, str]] = [
     ("osd.*", "recovered_objects", "rec/s"),
     ("mon*", "epochs", "epo/s"),
     ("mgr*", "balancer_rounds", "bal/s"),
+    # data-race checker violations/s — nonzero here means a daemon
+    # recorded an Eraser lockset/confinement report since the last
+    # poll (normally dead-zero; see dump_racecheck for the stacks)
+    ("analysis.race", "violations", "race"),
 ]
 
 

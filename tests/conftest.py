@@ -32,6 +32,11 @@ def pytest_configure(config):
 # stacks.  Must precede any ceph_tpu import — make_lock() decides
 # wrapper-vs-raw at construction time.
 os.environ.setdefault("CEPH_TPU_LOCKDEP", "1")
+# racecheck rides lockdep's held-set: the data-race lockset checker is
+# on for the whole suite too (overridable with CEPH_TPU_RACECHECK=0).
+# Must also precede any ceph_tpu import — guarded_by()/shared()
+# decide instrument-vs-identity at class decoration time.
+os.environ.setdefault("CEPH_TPU_RACECHECK", "1")
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -77,7 +82,8 @@ sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 GOLDEN_DIR = pathlib.Path(__file__).resolve().parent / "golden"
 
-from ceph_tpu.analysis import jaxcheck, lockdep, watchdog  # noqa: E402
+from ceph_tpu.analysis import (jaxcheck, lockdep, racecheck,  # noqa: E402
+                               watchdog)
 from ceph_tpu.common import bufpool, tracing  # noqa: E402
 
 # -- JAX hygiene gates (the XLA twin of the concurrency gates below) --
@@ -173,6 +179,7 @@ def _concurrency_gate(request):
     before_spans = {id(s) for _svc, s in tracing.active_spans()}
     before_segs = len(bufpool.outstanding())
     base = len(lockdep.violations())
+    race_base = racecheck.mark()
     yield
     vs = lockdep.violations()[base:]
     if vs:
@@ -184,6 +191,13 @@ def _concurrency_gate(request):
             for v in vs)
         pytest.fail(f"lockdep: {len(vs)} lock-order violation(s) "
                     f"during this test:\n{detail}")
+
+    # racecheck gate: a data-race violation (empty candidate lockset,
+    # broken thread confinement) fails the owning test with both
+    # access stacks, exactly like the lockdep gate above
+    race_msg = racecheck.gate_check(race_base)
+    if race_msg is not None:
+        pytest.fail(race_msg)
 
     def leaked():
         return [t for t in threading.enumerate()

@@ -581,3 +581,23 @@ def test_perf_history_ingests_chaos_records(tmp_path):
         {"kind": "chaos", "seed": 9, "ops": 118, "lost": 2,
          "health_converge_s": 1.0, "ok": False}))
     assert perf_history.main([str(tmp_path), "--check"]) == 1
+
+
+def test_perf_history_ingests_race_records(tmp_path):
+    (tmp_path / "RACE_r01.json").write_text(json.dumps(
+        {"kind": "race", "seed": 8, "violations": 0, "lost": 0,
+         "checked": 50, "overhead_pct": 3.2, "ok": True}))
+    assert perf_history.main([str(tmp_path), "--check"]) == 0
+    rows = perf_history.load_all(str(tmp_path))
+    assert rows[-1]["metrics"]["race_violations"] == 0.0
+    assert rows[-1]["metrics"]["race_overhead_pct"] == 3.2
+    # ANY recorded data-race violation is a regression outright
+    (tmp_path / "RACE_r02.json").write_text(json.dumps(
+        {"kind": "race", "seed": 8, "violations": 1, "lost": 0,
+         "checked": 50, "overhead_pct": 3.0, "ok": False}))
+    assert perf_history.main([str(tmp_path), "--check"]) == 1
+    # ...and so is a checker-overhead breach, even with ok=true
+    (tmp_path / "RACE_r02.json").write_text(json.dumps(
+        {"kind": "race", "seed": 8, "violations": 0, "lost": 0,
+         "checked": 50, "overhead_pct": 12.5, "ok": True}))
+    assert perf_history.main([str(tmp_path), "--check"]) == 1

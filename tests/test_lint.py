@@ -217,36 +217,14 @@ def _jlint(tmp_path, source, name="mod.py"):
     return lint_jax.lint_file(f)
 
 
-# Known-acceptable JAX002 hits in ceph_tpu/: every one is a deliberate
-# host<->device API boundary, not a hot-loop sync point.  An entry is
-# (path suffix, code, substring that must appear on the flagged line);
-# a NEW violation matches none of these and fails the test.
-JAX_ALLOWLIST = (
-    # batch ingest: normalize caller arrays once before device upload
-    ("crush/mapper_jax.py", "JAX002", "np.asarray(xs, np.uint32)"),
-    ("crush/mapper_jax.py", "JAX002", "np.asarray(weight, np.uint32)"),
-    ("crush/mapper_spec.py", "JAX002", "np.asarray(xs, np.uint32)"),
-    ("crush/mapper_spec.py", "JAX002", "np.asarray(weight, np.uint32)"),
-    # the explicit *_np host-egress API of the RS facade
-    ("ec/rs_jax.py", "JAX002", "np.asarray(self.encode(data))"),
-    ("ec/rs_jax.py", "JAX002", "np.asarray(self.decode(chunks"),
-    # per-epoch upload of the mutable OSD map vectors
-    ("osdmap/pipeline_jax.py", "JAX002", "np.asarray(m.osd_weight"),
-    ("osdmap/pipeline_jax.py", "JAX002", "np.asarray(m.osd_state"),
-    ("osdmap/pipeline_jax.py", "JAX002", "np.asarray("),
-    # np.asarray over the device LIST building a Mesh (no data moved)
-    ("parallel/placement.py", "JAX002", "np.asarray(devices)"),
-)
+# Known-acceptable JAX002 hits live in tools/lint_jax.py (ALLOWLIST)
+# so the CLI, the unified tools/lint.py runner and this test share one
+# source of truth about what is clean.
+JAX_ALLOWLIST = lint_jax.ALLOWLIST
 
 
 def _jax_allowlisted(v):
-    src = (REPO / "ceph_tpu" / ".." / v.path).resolve()
-    try:
-        line = src.read_text().splitlines()[v.line - 1]
-    except (OSError, IndexError):
-        return False
-    return any(v.path.endswith(path) and v.code == code and sub in line
-               for path, code, sub in JAX_ALLOWLIST)
+    return lint_jax.allowlisted(v)
 
 
 def test_repo_is_jax_clean():
@@ -988,3 +966,275 @@ def test_fault_cli_exit_status(tmp_path):
         [sys.executable, str(REPO / "tools" / "lint_faults.py"),
          str(good)], capture_output=True, text=True)
     assert p.returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# CONC005: unguarded writes to declared race-guarded state
+# ---------------------------------------------------------------------------
+
+def test_conc005_unguarded_write_flagged(tmp_path):
+    vs = _lint(tmp_path, """
+        from ceph_tpu.analysis.lockdep import make_lock
+        from ceph_tpu.analysis.racecheck import guarded_by
+
+        @guarded_by("svc::state", "table")
+        class Svc:
+            def __init__(self):
+                self._lock = make_lock("svc::state")
+                self.table = {}
+
+            def reset(self):
+                self.table = {}
+    """)
+    assert codes(vs) == ["CONC005"]
+    assert "table" in vs[0].message and "svc::state" in vs[0].message
+    assert "_lock" in vs[0].message  # names the lock attr to take
+
+
+def test_conc005_write_under_declared_lock_ok(tmp_path):
+    vs = _lint(tmp_path, """
+        from ceph_tpu.analysis.lockdep import make_lock
+        from ceph_tpu.analysis.racecheck import guarded_by
+
+        @guarded_by("svc::state", "table")
+        class Svc:
+            def __init__(self):
+                self._lock = make_lock("svc::state")
+                self.table = {}
+
+            def reset(self):
+                with self._lock:
+                    self.table = {}
+    """)
+    assert vs == []
+
+
+def test_conc005_init_and_owned_fields_exempt(tmp_path):
+    # __init__ is the single-owner init phase; owned_by_thread fields
+    # are writer-confined, not lock-disciplined
+    vs = _lint(tmp_path, """
+        from ceph_tpu.analysis.lockdep import make_lock
+        from ceph_tpu.analysis.racecheck import guarded_by
+
+        @guarded_by("svc::state", "table", owned_by_thread=("scratch",))
+        class Svc:
+            def __init__(self):
+                self._lock = make_lock("svc::state")
+                self.table = {}
+                self.scratch = 0
+
+            def sample(self):
+                self.scratch += 1
+    """)
+    assert vs == []
+
+
+def test_conc005_race_ok_requires_reason(tmp_path):
+    suppressed = _lint(tmp_path, """
+        from ceph_tpu.analysis.lockdep import make_lock
+        from ceph_tpu.analysis.racecheck import guarded_by
+
+        @guarded_by("svc::state", "table")
+        class Svc:
+            def __init__(self):
+                self._lock = make_lock("svc::state")
+                self.table = {}
+
+            def mount(self):
+                self.table = {}  # race-ok: mount-time, single-threaded
+    """)
+    assert suppressed == []
+    bare = _lint(tmp_path, """
+        from ceph_tpu.analysis.lockdep import make_lock
+        from ceph_tpu.analysis.racecheck import guarded_by
+
+        @guarded_by("svc::state", "table")
+        class Svc:
+            def __init__(self):
+                self._lock = make_lock("svc::state")
+                self.table = {}
+
+            def mount(self):
+                self.table = {}  # race-ok:
+    """)
+    assert codes(bare) == ["CONC005"]
+    assert "no reason" in bare[0].message
+
+
+def test_conc005_nested_def_resets_held_set(tmp_path):
+    # a closure defined under the lock runs LATER, lock-free
+    vs = _lint(tmp_path, """
+        from ceph_tpu.analysis.lockdep import make_lock
+        from ceph_tpu.analysis.racecheck import guarded_by
+
+        @guarded_by("svc::state", "table")
+        class Svc:
+            def __init__(self):
+                self._lock = make_lock("svc::state")
+                self.table = {}
+
+            def arm(self, timers):
+                with self._lock:
+                    def fire():
+                        self.table = {}
+                    timers.append(fire)
+    """)
+    assert codes(vs) == ["CONC005"]
+
+
+def test_conc005_module_level_guard_accepts_any_lock(tmp_path):
+    # guard's lock is not a self attribute: any lockish with suffices
+    vs = _lint(tmp_path, """
+        from ceph_tpu.analysis.lockdep import make_lock
+        from ceph_tpu.analysis.racecheck import guarded_by
+
+        _mod_lock = make_lock("svc::module")
+
+        @guarded_by("svc::module", "table")
+        class Svc:
+            def __init__(self):
+                self.table = {}
+
+            def reset(self):
+                with _mod_lock:
+                    self.table = {}
+    """)
+    assert vs == []
+
+
+# ---------------------------------------------------------------------------
+# Config-option lint (tools/lint_config.py, CONF001)
+# ---------------------------------------------------------------------------
+
+from tools import lint_config  # noqa: E402
+
+
+def _cflint(tmp_path, source):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(source))
+    return lint_config.lint_file(f)
+
+
+def test_repo_is_config_clean():
+    violations = lint_config.lint_paths([REPO / "ceph_tpu"])
+    assert not violations, "\n".join(str(v) for v in violations)
+
+
+def test_conf001_unknown_literal_flagged(tmp_path):
+    vs = _cflint(tmp_path, """
+        def f(ctx):
+            return ctx.conf.get("osd_heartbaet_interval")
+    """)
+    assert [v.code for v in vs] == ["CONF001"]
+    assert "osd_heartbaet_interval" in vs[0].message
+
+
+def test_conf001_known_option_ok(tmp_path):
+    vs = _cflint(tmp_path, """
+        def f(self, conf):
+            conf.set("osd_heartbeat_interval", 1.0)
+            self.ctx.conf.add_observer("debug_osd", print)
+            return conf.get("osd_pool_default_size")
+    """)
+    assert vs == []
+
+
+def test_conf001_subscript_access_checked(tmp_path):
+    vs = _cflint(tmp_path, """
+        def f(config):
+            return config["not_an_option_at_all"]
+    """)
+    assert [v.code for v in vs] == ["CONF001"]
+
+
+def test_conf001_fstring_pattern(tmp_path):
+    # at least one registered option must match the literal fragments
+    ok = _cflint(tmp_path, """
+        def f(conf, subsys):
+            return conf.get(f"debug_{subsys}")
+    """)
+    assert ok == []
+    gone = _cflint(tmp_path, """
+        def f(conf, subsys):
+            return conf.get(f"tracing_{subsys}_level")
+    """)
+    assert [v.code for v in gone] == ["CONF001"]
+
+
+def test_conf001_non_config_receiver_ignored(tmp_path):
+    vs = _cflint(tmp_path, """
+        def f(store, d):
+            store.get("definitely_not_an_option")
+            return d["also_not_an_option"]
+    """)
+    assert vs == []
+
+
+def test_conf001_suppression_requires_reason(tmp_path):
+    ok = _cflint(tmp_path, """
+        def f(conf, name):
+            return conf.get("future_option")  # conf-ok: staged for PR 19
+    """)
+    assert ok == []
+    bare = _cflint(tmp_path, """
+        def f(conf, name):
+            return conf.get("future_option")  # conf-ok:
+    """)
+    assert [v.code for v in bare] == ["CONF001"]
+    assert "no reason" in bare[0].message
+
+
+def test_config_cli_exit_status(tmp_path):
+    import subprocess
+    import sys
+
+    bad = tmp_path / "bad.py"
+    bad.write_text('def f(conf):\n    return conf.get("nope_opt")\n')
+    p = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_config.py"),
+         str(bad)], capture_output=True, text=True)
+    assert p.returncode == 1
+    assert "CONF001" in p.stdout
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    p = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_config.py"),
+         str(good)], capture_output=True, text=True)
+    assert p.returncode == 0
+
+
+# ---------------------------------------------------------------------------
+# Unified runner (tools/lint.py)
+# ---------------------------------------------------------------------------
+
+def test_lint_runner_registry_matches_module_set():
+    """Adding tools/lint_foo.py without registering it in
+    tools/lint.py FAMILIES (or vice versa) fails here — the unified
+    runner cannot silently miss a family."""
+    from tools import lint as lint_runner
+
+    on_disk = {p.stem[len("lint_"):]
+               for p in (REPO / "tools").glob("lint_*.py")}
+    assert set(lint_runner.FAMILIES) == on_disk
+    for name, mod in lint_runner.FAMILIES.items():
+        assert mod.__name__ == f"tools.lint_{name}", name
+
+
+def test_lint_runner_cli_exit_status(tmp_path):
+    import subprocess
+    import sys
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import threading\nx = threading.Lock()\n")
+    p = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"), str(bad)],
+        capture_output=True, text=True)
+    assert p.returncode == 1
+    assert "lint FAILED: concurrency" in p.stdout
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    p = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"), str(good)],
+        capture_output=True, text=True)
+    assert p.returncode == 0
+    assert "lint clean (6 families)" in p.stdout

@@ -19,7 +19,7 @@ import pytest
 from ceph_tpu.common.admin_socket import AdminSocket
 from ceph_tpu.common.config import Config
 from ceph_tpu.mgr import (evaluate, make_synthetic_map, run_offline)
-from ceph_tpu.mgr.daemon import MgrModule
+from ceph_tpu.mgr.daemon import MgrModule, _ModuleSched
 from ceph_tpu.services.cluster import MiniCluster
 
 
@@ -138,11 +138,13 @@ def test_mgr_module_framework_and_health_fold():
         # and the monitor's coded health grows MGR_MODULE_ERROR
         mgr.modules["boom"] = _Boom(mgr)
         mgr.enabled["boom"] = True
-        mgr._sched["boom"] = {"due": 0.0, "bo": None, "error": None}
+        with mgr._lock:
+            mgr._sched["boom"] = _ModuleSched()
         _wait(lambda: "MGR_MODULE_ERROR" in
               cl.health()["check_codes"], 20,
               "MGR_MODULE_ERROR health check")
-        assert mgr._sched["boom"]["error"]
+        with mgr._lock:
+            assert mgr._sched["boom"].error
         # disabling clears the fold on the next report
         mgr.enabled["boom"] = False
         _wait(lambda: "MGR_MODULE_ERROR" not in

@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Unified lint runner — every static-analysis family in one pass.
+
+One invocation, one exit code: runs each ``tools/lint_*.py`` family's
+``main()`` over the same targets and fails if ANY family found a
+violation — the single CI entry point, so a new lint family added to
+``tools/`` cannot be forgotten by the build (tests/test_lint.py pins
+the FAMILIES registry against the ``lint_*.py`` module set on disk).
+
+Families:
+    concurrency  CONC001-005  lock registry, blocking-under-lock,
+                              swallowed run-loops, span leaks,
+                              unguarded writes to declared state
+    jax          JAX001-004   device calls under locks/handlers,
+                              host-device sync points, stale jit
+                              captures, traced-value branching
+    wire         WIRE001-003  wire-format/codec drift
+    obs          OBS001-003 + COPY001  counter-registry drift,
+                              profiler gating, hot-path copies
+    faults       FAULT001-002 failpoint table drift
+    config       CONF001      option names absent from the schema
+
+Usage:
+    python tools/lint.py [paths...]   # default: each family's own
+                                      # default target (ceph_tpu/)
+Exit status 1 when any family found violations.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+from typing import List
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tools import (lint_concurrency, lint_config, lint_faults,  # noqa: E402
+                   lint_jax, lint_obs, lint_wire)
+
+# family key -> the module whose main() runs it; keys are the
+# lint_*.py stem minus the prefix (tests pin this against the on-disk
+# module set, so adding tools/lint_foo.py without registering it here
+# fails the suite)
+FAMILIES = {
+    "concurrency": lint_concurrency,
+    "config": lint_config,
+    "faults": lint_faults,
+    "jax": lint_jax,
+    "obs": lint_obs,
+    "wire": lint_wire,
+}
+
+
+def main(argv: List[str]) -> int:
+    failed = []
+    for name in sorted(FAMILIES):
+        print(f"== lint: {name} ==")
+        if FAMILIES[name].main(list(argv)) != 0:
+            failed.append(name)
+    if failed:
+        print(f"lint FAILED: {', '.join(failed)}")
+        return 1
+    print(f"lint clean ({len(FAMILIES)} families)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

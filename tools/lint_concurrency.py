@@ -32,6 +32,16 @@ CONC004  a ``start_span(...)`` call whose result is not the context
          (tests/conftest.py) then fails; ``with
          tracer.start_span(...) as sp:`` finishes on every path.
 
+CONC005  a write to an attribute a ``@guarded_by(<lock>, ...)``
+         declaration (analysis/racecheck.py) covers, lexically
+         outside a ``with`` block holding that class's lock for the
+         declared guard name.  The static half of the runtime lockset
+         checker: the dynamic checker needs the racing interleaving
+         to actually run; this catches the unguarded write at review
+         time.  ``owned_by_thread`` fields are thread-confined, not
+         lock-disciplined, and are exempt.  Suppress with
+         ``# race-ok: <reason>`` — the reason is mandatory.
+
 Suppression: append ``# conc-ok: <reason>`` to the offending line (or
 the ``with``/``except``/``def`` line introducing it).  The reason is
 mandatory — it is the allowlist entry.
@@ -50,9 +60,13 @@ from dataclasses import dataclass
 from typing import Iterable, List, Optional
 
 SUPPRESS_MARK = "conc-ok:"
+RACE_MARK = "race-ok:"
 
 # files allowed to touch raw threading primitives: the registry itself
-ALLOW_RAW_FILES = ("analysis/lockdep.py", "analysis/watchdog.py")
+# (and racecheck, whose violation-record lock must not feed back into
+# the lockset checker it implements)
+ALLOW_RAW_FILES = ("analysis/lockdep.py", "analysis/watchdog.py",
+                   "analysis/racecheck.py")
 
 # names whose .attr call blocks by design
 BLOCKING_ATTRS = {"fsync", "recv", "sleep"}
@@ -114,6 +128,29 @@ def _is_blocking_call(node: ast.Call) -> bool:
     elif isinstance(f, ast.Name) and f.id in BLOCKING_ATTRS:
         return True
     return False
+
+
+def _guarded_decls(cls: ast.ClassDef) -> dict:
+    """{field: guard name} from the class's stacked ``@guarded_by``
+    decorators.  ``owned_by_thread`` fields are excluded — they are
+    writer-confined, not lock-disciplined (CONC005's scope)."""
+    out: dict = {}
+    for dec in cls.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        f = dec.func
+        fname = f.attr if isinstance(f, ast.Attribute) \
+            else getattr(f, "id", "")
+        if fname != "guarded_by":
+            continue
+        consts = [a.value for a in dec.args
+                  if isinstance(a, ast.Constant)
+                  and isinstance(a.value, str)]
+        if len(consts) < 2:
+            continue
+        for field in consts[1:]:
+            out[field] = consts[0]
+    return out
 
 
 def _broad_except(handler: ast.ExceptHandler) -> Optional[str]:
@@ -230,6 +267,109 @@ class _FileLinter(ast.NodeVisitor):
 
     def visit_AsyncFunctionDef(self, node) -> None:
         self._visit_function(node)
+
+    # -- CONC005 ------------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        guards = _guarded_decls(node)
+        if guards:
+            self._check_guarded_class(node, guards)
+        self.generic_visit(node)
+
+    def _check_guarded_class(self, cls: ast.ClassDef,
+                             guards: dict) -> None:
+        # guard name -> the self attribute holding that named lock
+        # (``self._lock = make_lock("osd::state")``); a guard whose
+        # lock lives elsewhere (module level) matches any lockish with
+        lock_attrs: dict = {}
+        for n in ast.walk(cls):
+            if not (isinstance(n, ast.Assign)
+                    and isinstance(n.value, ast.Call)
+                    and n.value.args
+                    and isinstance(n.value.args[0], ast.Constant)):
+                continue
+            f = n.value.func
+            fname = f.attr if isinstance(f, ast.Attribute) \
+                else getattr(f, "id", "")
+            if fname not in ("make_lock", "make_rlock"):
+                continue
+            for t in n.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    lock_attrs[n.value.args[0].value] = t.attr
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) and \
+                    item.name != "__init__":
+                self._check_guarded_writes(item, guards, lock_attrs)
+
+    def _check_guarded_writes(self, fn, guards: dict,
+                              lock_attrs: dict) -> None:
+        def with_lock_attrs(node: ast.With) -> List[str]:
+            out = []
+            for item in node.items:
+                if _is_lockish(item.context_expr):
+                    try:
+                        text = ast.unparse(item.context_expr)
+                    except Exception:
+                        continue
+                    out.append(text.split("(", 1)[0]
+                               .rsplit(".", 1)[-1])
+            return out
+
+        def walk(node, held: frozenset) -> None:
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign)):
+                targets = node.targets if isinstance(
+                    node, ast.Assign) else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self" and t.attr in guards:
+                        gname = guards[t.attr]
+                        want = lock_attrs.get(gname)
+                        ok = (want in held) if want else bool(held)
+                        if not ok:
+                            self._emit_race(node, t.attr, gname, want)
+            if isinstance(node, ast.With):
+                inner = held | frozenset(with_lock_attrs(node))
+                for item in node.items:
+                    walk(item, held)
+                for stmt in node.body:
+                    walk(stmt, inner)
+                return
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef, ast.Lambda)):
+                # a nested def is a fresh frame: the enclosing with
+                # is not held when the inner function eventually runs
+                for child in ast.iter_child_nodes(node):
+                    walk(child, frozenset())
+                return
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in fn.body:
+            walk(stmt, frozenset())
+
+    def _emit_race(self, node: ast.AST, field: str, gname: str,
+                   want: Optional[str]) -> None:
+        line = self.lines[node.lineno - 1] \
+            if 1 <= node.lineno <= len(self.lines) else ""
+        if RACE_MARK in line:
+            reason = line.split(RACE_MARK, 1)[1].strip()
+            if reason:
+                return  # suppressed, with its mandatory reason
+            self.out.append(Violation(
+                self.rel, node.lineno, "CONC005",
+                f"'# race-ok:' on the write to {field!r} carries no "
+                f"reason — the reason is the allowlist entry"))
+            return
+        hold = f"`with self.{want}:`" if want \
+            else f"a with-block holding {gname!r}"
+        self.out.append(Violation(
+            self.rel, node.lineno, "CONC005",
+            f"write to {field!r} (declared guarded by {gname!r}) "
+            f"outside {hold}"))
 
 
 def lint_file(path: pathlib.Path,

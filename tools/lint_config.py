@@ -1,0 +1,181 @@
+#!/usr/bin/env python
+"""Config-option lint — static companion to the option schema.
+
+CONF001  a literal (or f-string) option name passed to a ``Config``
+         access — ``conf.get("name")`` / ``conf.set("name", v)`` /
+         ``conf.add_observer("name", cb)`` / ``conf["name"]`` on a
+         receiver named ``conf``/``config``/``cfg`` at any attribute
+         depth (``self.ctx.conf``, ``ctx.conf``) — that does not
+         exist in the option schema
+         (``ceph_tpu/common/config.py`` OPTIONS).  ``Config.get``
+         raises ``KeyError`` on unknown names, so a typo'd option is
+         a latent crash on whatever path first reads it — usually a
+         rarely-exercised error branch; this catches it at review
+         time instead.  F-string names (``f"debug_{subsys}"``) turn
+         their literal fragments into a pattern: at least one
+         registered option must match, so renaming a family away
+         from under the pattern still fails.
+
+Suppression: append ``# conf-ok: <reason>`` to the offending line.
+The reason is mandatory — it is the allowlist entry.
+
+Usage:
+    python tools/lint_config.py [paths...]   # default: ceph_tpu/
+Exit status 1 when violations are found.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+from dataclasses import dataclass
+from typing import Iterable, List, Optional
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from ceph_tpu.common.config import OPTIONS  # noqa: E402
+
+SUPPRESS_MARK = "conf-ok:"
+
+RECEIVERS = {"conf", "config", "cfg", "_conf", "_config"}
+ACCESS_METHODS = {"get", "set", "add_observer", "remove_observer",
+                  "rm_override", "source_of"}
+
+
+@dataclass
+class Violation:
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _receiver_name(expr: ast.AST) -> str:
+    """Last dotted component of the receiver expression
+    (``self.ctx.conf`` -> ``conf``)."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def _fstring_pattern(node: ast.JoinedStr) -> Optional[str]:
+    """Anchored regex from an f-string's literal fragments, or None
+    when it has no constant text to pin a match on."""
+    parts: List[str] = []
+    has_literal = False
+    for v in node.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(re.escape(v.value))
+            has_literal = True
+        else:
+            parts.append(".*")
+    return "^" + "".join(parts) + "$" if has_literal else None
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, rel: str, src: str):
+        self.rel = rel
+        self.lines = src.splitlines()
+        self.out: List[Violation] = []
+
+    def _suppressed(self, lineno: int) -> bool:
+        line = self.lines[lineno - 1] \
+            if 1 <= lineno <= len(self.lines) else ""
+        if SUPPRESS_MARK not in line:
+            return False
+        if line.split(SUPPRESS_MARK, 1)[1].strip():
+            return True
+        self.out.append(Violation(
+            self.rel, lineno, "CONF001",
+            "'# conf-ok:' carries no reason — the reason is the "
+            "allowlist entry"))
+        return True
+
+    def _check_name(self, node: ast.AST, name_node: ast.AST,
+                    how: str) -> None:
+        if isinstance(name_node, ast.Constant):
+            if not isinstance(name_node.value, str):
+                return
+            name = name_node.value
+            if name in OPTIONS or self._suppressed(node.lineno):
+                return
+            self.out.append(Violation(
+                self.rel, node.lineno, "CONF001",
+                f"option {name!r} ({how}) is not in the schema "
+                f"(ceph_tpu/common/config.py OPTIONS) — "
+                f"Config.get raises KeyError on it"))
+        elif isinstance(name_node, ast.JoinedStr):
+            pat = _fstring_pattern(name_node)
+            if pat is None:
+                return
+            if any(re.match(pat, opt) for opt in OPTIONS) or \
+                    self._suppressed(node.lineno):
+                return
+            self.out.append(Violation(
+                self.rel, node.lineno, "CONF001",
+                f"f-string option pattern {pat!r} ({how}) matches "
+                f"no schema option — the family it named is gone"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and \
+                f.attr in ACCESS_METHODS and \
+                _receiver_name(f.value) in RECEIVERS and node.args:
+            self._check_name(node, node.args[0], f"conf.{f.attr}")
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if _receiver_name(node.value) in RECEIVERS:
+            self._check_name(node, node.slice, "conf[...]")
+        self.generic_visit(node)
+
+
+def lint_file(path: pathlib.Path,
+              root: Optional[pathlib.Path] = None) -> List[Violation]:
+    rel = str(path if root is None else path.relative_to(root))
+    src = path.read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [Violation(rel, e.lineno or 0, "CONF000",
+                          f"unparseable: {e.msg}")]
+    linter = _FileLinter(rel, src)
+    linter.visit(tree)
+    return sorted(linter.out, key=lambda v: v.line)
+
+
+def lint_paths(paths: Iterable[pathlib.Path]) -> List[Violation]:
+    out: List[Violation] = []
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_dir():
+            root = p.parent
+            for f in sorted(p.rglob("*.py")):
+                out.extend(lint_file(f, root=root))
+        else:
+            out.extend(lint_file(p))
+    return out
+
+
+def main(argv: List[str]) -> int:
+    targets = [pathlib.Path(a) for a in argv] or \
+        [pathlib.Path(__file__).resolve().parents[1] / "ceph_tpu"]
+    violations = lint_paths(targets)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} config lint violation(s)")
+        return 1
+    print("config lint clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

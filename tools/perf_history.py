@@ -65,6 +65,8 @@ METRICS = [
     ("netsplit_false_markdowns", False),
     ("netsplit_detect_s", False),
     ("netsplit_epoch_churn", False),
+    ("race_violations", False),
+    ("race_overhead_pct", False),
     ("attr_unattr_pct", False),
     ("copy_bytes_per_op", False),
     ("prof_overhead_pct", False),
@@ -350,6 +352,37 @@ def load_netsplit(path: str) -> Optional[Dict]:
     return {"metrics": metrics, "fail": fail}
 
 
+def load_race(path: str) -> Optional[Dict]:
+    """One RACE_rNN.json data-race-audit record (tools/thrasher.py
+    --race-audit): the violation count and checker-overhead metrics
+    join the trajectory, and the gate is absolute — ANY recorded
+    lockset/confinement violation, any acked-write loss under the
+    drills, a failed audit verdict, or checker overhead at/over 10%
+    is a regression outright (a data race has no acceptable drift)."""
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"# {path}: unreadable ({e})", file=sys.stderr)
+        return None
+    metrics: Dict[str, float] = {}
+    if isinstance(raw.get("violations"), (int, float)):
+        metrics["race_violations"] = float(raw["violations"])
+    if isinstance(raw.get("overhead_pct"), (int, float)):
+        metrics["race_overhead_pct"] = float(raw["overhead_pct"])
+    fail: List[str] = []
+    if raw.get("violations"):
+        fail.append(f"race_violations={raw['violations']}")
+    if raw.get("lost"):
+        fail.append(f"race_lost_writes={raw['lost']}")
+    ov = raw.get("overhead_pct")
+    if not isinstance(ov, (int, float)) or ov >= 10.0:
+        fail.append(f"race_checker_overhead={ov}")
+    if raw.get("ok") is False:
+        fail.append("race_audit_failed")
+    return {"metrics": metrics, "fail": fail}
+
+
 def load_all(directory: str) -> List[Dict]:
     rows = []
     for path in sorted(glob.glob(os.path.join(directory,
@@ -464,6 +497,28 @@ def load_all(directory: str) -> List[Dict]:
         for k, v in ns["metrics"].items():
             row["metrics"].setdefault(k, v)
         row["slo_fail"].extend(ns["fail"])
+    # RACE_rNN data-race-audit records: violation count and checker
+    # overhead merge onto the same-numbered row; any violation, lost
+    # write or overhead breach rides slo_fail into the regression
+    # check
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "RACE_r*.json"))):
+        m = re.search(r"RACE_r(\d+)\.json$", path)
+        rc_ = load_race(path)
+        if rc_ is None or m is None or \
+                not (rc_["metrics"] or rc_["fail"]):
+            continue
+        n = int(m.group(1))
+        row = by_n.get(n)
+        if row is None:
+            row = {"run": f"r{n:02d}", "n": n,
+                   "path": os.path.basename(path), "rc": None,
+                   "platform": None, "metrics": {}, "slo_fail": []}
+            by_n[n] = row
+            rows.append(row)
+        for k, v in rc_["metrics"].items():
+            row["metrics"].setdefault(k, v)
+        row["slo_fail"].extend(rc_["fail"])
     rows.sort(key=lambda r: r["n"])
     return rows
 

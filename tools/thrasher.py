@@ -58,6 +58,14 @@ OSD_SLOW_PING_TIME must rise from its ping lag, the send stall must
 book on the victim's messenger only, and once the throttle lifts the
 cluster must clear to HEALTH_OK with zero acked-write loss (emits
 ``SLODRILL_rNN.json``).
+
+``--race-audit`` runs the chaos soak, the netsplit drills and the
+SLO-escalation drill back to back with the data-race checker
+(ceph_tpu/analysis/racecheck.py) armed over every swept daemon, then
+probes the checker's overhead on a clean write lane in paired
+subprocesses (checker on vs off).  The ``RACE_rNN.json`` record is
+red-checked hard by tools/perf_history.py: any lockset/confinement
+violation, any acked-write loss, or >=10% checker overhead fails.
 """
 
 from __future__ import annotations
@@ -79,7 +87,15 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
 
-from ceph_tpu.analysis import faults, lockdep  # noqa: E402
+# --race-audit arms the data-race checker, whose guarded_by
+# decorators install their descriptors at class-definition time — the
+# env must be set BEFORE any ceph_tpu import or the sweep's guard
+# declarations are identity no-ops for this process
+if "--race-audit" in sys.argv:
+    os.environ["CEPH_TPU_RACECHECK"] = "1"
+    os.environ.setdefault("CEPH_TPU_LOCKDEP", "1")
+
+from ceph_tpu.analysis import faults, lockdep, racecheck  # noqa: E402
 from ceph_tpu.common import tracing  # noqa: E402
 from ceph_tpu.common.admin_socket import AdminSocket  # noqa: E402
 from ceph_tpu.common.backoff import Backoff  # noqa: E402
@@ -953,6 +969,118 @@ def netsplit(seed: int = 8) -> Dict:
     return rec
 
 
+def write_bench(seed: int = 8, duration: float = 4.0,
+                n_osds: int = 3) -> Dict:
+    """The checker-overhead probe body (hidden ``--write-bench``): a
+    steady replicated write lane, no chaos — ops/s under whatever
+    ``CEPH_TPU_RACECHECK`` setting this process was started with.
+    race_audit() runs it twice in subprocesses (checker armed vs not)
+    and gates the delta, so the comparison is decoration-time real on
+    both sides."""
+    c = MiniCluster(n_osds=n_osds, hosts=n_osds,
+                    config=_conf()).start()
+    out: Dict = {"kind": "write_bench", "seed": seed,
+                 "racecheck": racecheck.enabled()}
+    try:
+        c.create_replicated_pool(1, pg_num=8, size=3)
+        c.wait_for_health_ok()
+        cli = c.client("rc-bench")
+        val = b"x" * 4096
+        try:
+            t0 = time.monotonic()
+            ops = 0
+            while time.monotonic() - t0 < duration:
+                cli.put(1, f"k{ops % 64}", val)
+                ops += 1
+            dt = time.monotonic() - t0
+        finally:
+            cli.shutdown()
+        out["ops"] = ops
+        out["ops_per_s"] = round(ops / dt, 1)
+    finally:
+        c.shutdown()
+    return out
+
+
+def _bench_overhead(seed: int, runs: int = 3) -> Dict:
+    """Best-of-N write-bench ops/s with the checker armed vs
+    disarmed, each in its own subprocess (the guard declarations are
+    decoration-time, so an in-process toggle would measure nothing)."""
+    import subprocess
+
+    def probe(armed: bool) -> float:
+        env = dict(os.environ)
+        env["CEPH_TPU_RACECHECK"] = "1" if armed else "0"
+        env.setdefault("CEPH_TPU_LOCKDEP", "1")
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        best = 0.0
+        for _ in range(runs):
+            p = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--write-bench", "--seed", str(seed)],
+                capture_output=True, text=True, env=env,
+                timeout=300)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"write-bench subprocess failed: {p.stderr[-500:]}")
+            rec = json.loads(p.stdout.strip().splitlines()[-1])
+            best = max(best, float(rec["ops_per_s"]))
+        return best
+
+    on = probe(True)
+    off = probe(False)
+    return {"ops_per_s_checked": on, "ops_per_s_raw": off,
+            "overhead_pct": round(max(0.0, (1 - on / off) * 100), 2)
+            if off else None}
+
+
+def race_audit(seed: int = 8, soak_duration: float = 8.0) -> Dict:
+    """``--race-audit``: the full drill battery — chaos soak,
+    directional netsplits, SLO-escalation — with the data-race
+    checker armed over every swept daemon, then the checker-overhead
+    probe on the clean write lane.  The gate (red-checked via
+    RACE_rNN.json): ZERO racecheck violations, zero acked-write loss
+    anywhere, and checker overhead under 10%."""
+    if not (racecheck.enabled() and lockdep.enabled()):
+        raise RuntimeError(
+            "race_audit needs CEPH_TPU_RACECHECK=1 and lockdep "
+            "armed before ceph_tpu imports (run via --race-audit)")
+    base = racecheck.mark()
+    out: Dict = {"kind": "race", "seed": seed,
+                 "racecheck_enabled": True}
+    phases: Dict[str, Dict] = {}
+    vmark = base
+    for name, run in (
+            ("chaos", lambda: soak(seed=seed,
+                                   duration=soak_duration)),
+            ("netsplit", lambda: netsplit(seed=seed)),
+            ("slow_ops", lambda: slow_ops_drill(seed=seed))):
+        rec = run()
+        now = len(racecheck.violations())
+        phases[name] = {"ok": bool(rec.get("ok")),
+                        "lost": rec.get("lost", 0),
+                        "checked": rec.get("checked", 0),
+                        "violations": now - vmark}
+        vmark = now
+    out["phases"] = phases
+    new = racecheck.violations()[base:]
+    out["violations"] = len(new)
+    out["violation_reports"] = [v["message"] for v in new[:5]]
+    out["lost"] = sum(p["lost"] or 0 for p in phases.values())
+    out["checked"] = sum(p["checked"] or 0 for p in phases.values())
+    d = racecheck.dump()
+    out["guarded_classes"] = len(d["guarded_classes"])
+    out["guarded_fields"] = d["guarded_fields"]
+    out["shared_objects"] = d["shared_objects"]
+    out.update(_bench_overhead(seed))
+    out["ok"] = bool(
+        out["violations"] == 0 and out["lost"] == 0
+        and all(p["ok"] for p in phases.values())
+        and out["overhead_pct"] is not None
+        and out["overhead_pct"] < 10.0)
+    return out
+
+
 def next_run_number(directory: str) -> int:
     """One past the newest committed record of ANY series (BENCH /
     MULTICHIP / CHAOS / DRILL) so the record pairs with its PR's
@@ -991,6 +1119,14 @@ def main(argv=None) -> int:
                          "OSD_SLOW_PING_TIME and clear to "
                          "HEALTH_OK) instead of the chaos soak "
                          "(emits SLODRILL_rNN.json)")
+    ap.add_argument("--race-audit", action="store_true",
+                    help="run the chaos soak + netsplit + slow-ops "
+                         "drills with the data-race checker armed, "
+                         "then the checker-overhead probe; the gate "
+                         "is zero violations, zero acked-write loss "
+                         "and <10%% overhead (emits RACE_rNN.json)")
+    ap.add_argument("--write-bench", action="store_true",
+                    help=argparse.SUPPRESS)  # race-audit's subprocess
     ap.add_argument("--slo-p99-ms", type=float, default=250.0,
                     help="degraded-read soak p99 SLO in ms "
                          "(default 250)")
@@ -1000,15 +1136,24 @@ def main(argv=None) -> int:
                          "the newest committed record)")
     args = ap.parse_args(argv)
 
+    if args.write_bench:
+        # hidden overhead-probe worker: bare JSON on stdout for the
+        # parent race_audit(); no committed record
+        print(json.dumps(write_bench(seed=args.seed)))
+        return 0
+
     series = "DRILL" if args.host_kill else \
         "NETSPLIT" if args.netsplit else \
-        "SLODRILL" if args.slow_ops else "CHAOS"
+        "SLODRILL" if args.slow_ops else \
+        "RACE" if args.race_audit else "CHAOS"
     out = args.out
     if out is None:
         n = next_run_number(_ROOT)
         out = os.path.join(_ROOT, f"{series}_r{n:02d}.json")
     m = re.search(r"_r(\d+)\.json$", out)
-    if args.host_kill:
+    if args.race_audit:
+        rec = race_audit(seed=args.seed)
+    elif args.host_kill:
         rec = drill(seed=args.seed, slo_p99_ms=args.slo_p99_ms)
     elif args.netsplit:
         rec = netsplit(seed=args.seed)
@@ -1022,7 +1167,17 @@ def main(argv=None) -> int:
     with open(out, "w") as f:
         json.dump(rec, f, indent=1)
         f.write("\n")
-    if args.slow_ops:
+    if args.race_audit:
+        print(f"# race seed={rec['seed']} "
+              f"violations={rec.get('violations')} "
+              f"lost={rec.get('lost')}/{rec.get('checked')} "
+              f"guarded={rec.get('guarded_classes')}cls/"
+              f"{rec.get('guarded_fields')}flds "
+              f"overhead={rec.get('overhead_pct')}% "
+              f"({rec.get('ops_per_s_checked')} vs "
+              f"{rec.get('ops_per_s_raw')} op/s) -> "
+              f"{'OK' if rec['ok'] else 'FAIL'} ({out})")
+    elif args.slow_ops:
         print(f"# slowops seed={rec['seed']} victim=osd."
               f"{rec.get('victim')} raise={rec.get('raise_s')}s "
               f"stall={rec.get('victim_stall_s')}s "
