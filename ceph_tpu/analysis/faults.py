@@ -61,6 +61,9 @@ FAILPOINTS: Dict[str, str] = {
     "msgr.corrupt_frame": "one payload byte flipped on the wire",
     "msgr.close_mid_frame": "socket hard-closed after a partial "
                             "frame write",
+    "msgr.stall_dispatch": "control-lane dispatch callback delayed "
+                           "`delay` seconds inside its non-blocking "
+                           "scope (asyncheck loop-stall drill)",
     # objectstore / WAL faults (filestore_debug_inject_read_err role)
     "os.read_eio": "objectstore read raises EIO",
     "os.fsync_eio": "WAL group-commit fsync raises EIO (store "

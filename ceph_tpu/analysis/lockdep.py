@@ -51,7 +51,7 @@ _forced: Optional[bool] = None
 
 # raw lock on purpose: guards lockdep's own tables and must not feed
 # back into the graph it maintains
-_state = threading.Lock()  # conc-ok: lockdep's own registry lock
+_state = threading.Lock()  # lockdep's own registry lock
 _follows: Dict[str, Dict[str, str]] = {}  # a -> {b: witness stack}
 _reported: set = set()
 _violations: List[Dict] = []
@@ -344,7 +344,7 @@ class DLock:
 
     @staticmethod
     def _alloc():
-        return threading.Lock()  # conc-ok: the wrapped primitive
+        return threading.Lock()  # the wrapped primitive
 
     def acquire(self, blocking: bool = True,
                 timeout: float = -1) -> bool:
@@ -388,7 +388,7 @@ class DRLock(DLock):
 
     @staticmethod
     def _alloc():
-        return threading.RLock()  # conc-ok: the wrapped primitive
+        return threading.RLock()  # the wrapped primitive
 
     def locked(self) -> bool:
         return self._lock._is_owned()
@@ -411,8 +411,8 @@ class DRLock(DLock):
 def make_lock(name: str):
     """Registry hook: a named, lockdep-tracked mutex when the checker
     is enabled, a raw ``threading.Lock`` (zero overhead) otherwise."""
-    return DLock(name) if enabled() else threading.Lock()  # conc-ok: registry fallback
+    return DLock(name) if enabled() else threading.Lock()  # registry fallback
 
 
 def make_rlock(name: str):
-    return DRLock(name) if enabled() else threading.RLock()  # conc-ok: registry fallback
+    return DRLock(name) if enabled() else threading.RLock()  # registry fallback

@@ -34,7 +34,7 @@ from typing import Dict, List, Optional
 from . import lockdep
 
 # raw lock: the registry must never feed the graph it helps debug
-_sections_lock = threading.Lock()  # conc-ok: watchdog's own registry lock
+_sections_lock = threading.Lock()  # watchdog's own registry lock
 _sections: Dict[int, Dict] = {}
 _tokens = itertools.count()
 

@@ -108,7 +108,7 @@ class Decoder:
     def __init__(self, buf: bytes, pos: int = 0,
                  struct_name: str = "structure"):
         if isinstance(buf, memoryview):
-            # copy-ok: decode is the cold path (WAL replay, map
+            # decode is the cold path (WAL replay, map
             # install) and every primitive below slices + unpacks —
             # normalizing once beats a view-aware copy per field
             buf = bytes(buf)

@@ -122,6 +122,11 @@ OPTIONS: Dict[str, Option] = _opts(
            "runtime lock-order checking (analysis/lockdep.py); the "
            "CEPH_TPU_LOCKDEP env var is the usual switch — this "
            "option mirrors it for config-file-driven runs"),
+    Option("asyncheck_loop_budget_ms", float, 50.0,
+           "wallclock budget (ms) for one @nonblocking dispatch "
+           "callback before the asyncheck enforcer records an "
+           "overrun with both-end stacks (analysis/asyncheck.py; "
+           "active only under CEPH_TPU_ASYNCHECK=1)"),
     Option("watchdog_threshold", float, 30.0,
            "seconds a lock may stay held or a handler may run before "
            "the stall watchdog dumps all-thread stacks "

@@ -101,6 +101,20 @@ class Context:
                 "dump_racecheck", lambda _a: racecheck.dump(),
                 "data-race checker: guarded classes and recorded "
                 "lockset/confinement violations (both stacks)")
+            # the async-safety surface (analysis/asyncheck.py):
+            # @nonblocking contracts, live dispatch scopes (a stall in
+            # progress is named before it finishes), and recorded
+            # budget overruns with entry+witness stacks
+            from ..analysis import asyncheck
+
+            asyncheck.configure(
+                self.conf["asyncheck_loop_budget_ms"])
+            self._admin.register(
+                "dump_asyncheck", lambda _a: asyncheck.dump(),
+                "async-safety checker: non-blocking contracts, live "
+                "scopes, and callback-budget overruns (both stacks)")
+            if asyncheck.enabled():
+                asyncheck.start_global()
             self._admin.start()
             # a daemon with an admin plane gets the stall watchdog
             # behind it: dump_blocked serves on demand, the scanner

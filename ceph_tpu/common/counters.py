@@ -129,6 +129,7 @@ REGISTRY: Dict[str, Dict[str, str]] = {
         "msgr.dup_frame": U64,
         "msgr.corrupt_frame": U64,
         "msgr.close_mid_frame": U64,
+        "msgr.stall_dispatch": U64,
         "os.read_eio": U64,
         "os.fsync_eio": U64,
         "os.torn_append": U64,
@@ -252,6 +253,14 @@ REGISTRY: Dict[str, Dict[str, str]] = {
         "guarded_classes": GAUGE,
         "guarded_fields": GAUGE,
         "shared_objects": GAUGE,
+    },
+    # the async-safety checker (analysis/asyncheck.py): callback-
+    # budget overruns (normally 0 — the daemonperf `blk` column and
+    # thrasher --loop-stall read it) plus contract/scope gauges
+    "analysis.block": {
+        "overruns": U64,
+        "contracts": GAUGE,
+        "live_scopes": GAUGE,
     },
 }
 

@@ -83,7 +83,7 @@ class EncodeBatcher:
         if self._delay > 0:
             # widen the batch: let concurrent writers land their
             # requests before the shared dispatch (bounded by the knob)
-            time.sleep(self._delay)  # conc-ok: the leader mutex is the coalescing role, not a data lock; waiting here IS the batching window
+            time.sleep(self._delay)  # the leader mutex is the coalescing role, not a data lock; waiting here IS the batching window
         with self._qlock:
             batch, self._q = self._q, []
         if not batch:

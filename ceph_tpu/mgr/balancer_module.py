@@ -313,7 +313,7 @@ class BalancerModule(MgrModule):
         try:
             health = self.mgr.mon_call({"type": "health"},
                                        timeout=3.0)
-        except Exception as e:  # fault-ok: next tick re-probes
+        except Exception as e:  # next tick re-probes
             self.log.dout(5, f"balancer: health unavailable {e!r}")
             return None
         if self._degraded(health) and not force:
@@ -379,7 +379,7 @@ class BalancerModule(MgrModule):
                 rep = self.mgr.mon_call(
                     {"type": "pg_upmap_items_set",
                      "pool": pgid[0], "ps": pgid[1], "items": items})
-            except Exception as e:  # fault-ok: rest retried next round
+            except Exception as e:  # rest retried next round
                 self.log.dout(2, f"balancer: propose {pgid} failed "
                                  f"{e!r}")
                 break

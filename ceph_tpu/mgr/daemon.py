@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from ..analysis.asyncheck import nonblocking
 from ..analysis.lockdep import make_rlock
 from ..analysis.racecheck import guarded_by
 from ..common.backoff import Backoff
@@ -157,6 +158,7 @@ class MgrDaemon(MapFollower):
             name: _ModuleSched() for name in self.modules}
 
     # -- handlers ------------------------------------------------------
+    @nonblocking
     def _h_map_update(self, msg):
         self._install_map(msg["payload"])
         return None
@@ -293,7 +295,7 @@ class MgrDaemon(MapFollower):
                     self.mon_send({"type": "mgr_health_report",
                                    "name": self.name,
                                    "checks": checks})
-                except Exception as e:  # fault-ok: next delta re-sends
+                except Exception as e:  # next delta re-sends
                     last_health = None
                     self.log.dout(5, f"health report failed: {e!r}")
 

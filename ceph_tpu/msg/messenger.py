@@ -47,6 +47,7 @@ osd_client_message_size_cap role (ceph_osd.cc:582-588).
 from __future__ import annotations
 
 import collections
+import contextlib
 import itertools
 import json
 import socket
@@ -57,8 +58,10 @@ import uuid
 import zlib
 from typing import Callable, Dict, Optional, Tuple
 
+from ..analysis import asyncheck
 from ..analysis import faults
 from ..analysis import watchdog
+from ..analysis.asyncheck import nonblocking
 from ..analysis.lockdep import make_lock, make_rlock
 from ..analysis.racecheck import guarded_by, shared
 from ..common import bufpool
@@ -115,6 +118,11 @@ _sock_writers_guard = make_lock("msgr::send_guard")
 # idle cluster's meter reads exactly zero and any nonzero value means
 # the kernel buffer pushed back.
 _STALL_MIN_S = 1e-3
+
+# stateless reusable null context for the data-lane handler path (a
+# data handler may legitimately block on fan-out; only the control
+# lane carries the non-blocking contract)
+_NULL_CTX = contextlib.nullcontext()
 
 
 class _ConnStats:
@@ -962,6 +970,7 @@ class Messenger:
         if close_after:
             self._hard_close(conn)
 
+    @nonblocking
     def _dispatch(self, conn: socket.socket, msg: Dict, blobs: list,
                   nbytes: int, seg=None) -> None:
         """Owns ``seg`` — the pooled recv segment every blob view in
@@ -1006,8 +1015,17 @@ class Messenger:
                 key = (msg.get("frm", ""), msg.get("sess", ""))
                 with self._in_lock:
                     ins = self._in.setdefault(key, _InSession())
-                self._reply(conn, msg,
-                            {"in_seq": ins.in_seq, "ok": True})
+                # the handshake reply moves OFF the reader thread
+                # (asyncheck BLOCK001): _reply -> _send -> sendall
+                # can stall on a backpressured peer socket, and this
+                # thread is the one draining EVERY frame on the
+                # connection — a wedged hello reply froze acks,
+                # replies and dispatch behind it.  The in_seq
+                # snapshot is taken above, so a delayed send changes
+                # nothing the peer can observe.
+                self._pool_submit(self._reply, conn, msg,
+                                  {"in_seq": ins.in_seq, "ok": True},
+                                  control=True)
                 return
 
             seq = msg.get("_s")
@@ -1203,8 +1221,22 @@ class Messenger:
                             cs.wait_data_s += q_wait
                             cs.wait_data_n += 1
                     # watchdog-visible: a handler wedged on a lock or a
-                    # peer RPC shows up in dump_blocked with its stack
-                    with watchdog.section(f"{self.name}:{type_}"):
+                    # peer RPC shows up in dump_blocked with its stack.
+                    # Control-lane handlers additionally run as timed
+                    # non-blocking scopes (asyncheck): the control lane
+                    # is the future event loop's inline lane, so a
+                    # handler overrunning asyncheck_loop_budget_ms is
+                    # recorded with both-end stack witnesses
+                    with watchdog.section(f"{self.name}:{type_}"), (
+                            asyncheck.scope(
+                                f"handler:{self.name}:{type_}")
+                            if ctl else _NULL_CTX):
+                        if ctl and faults._ACTIVE:
+                            # the --loop-stall drill's armed delay
+                            # fires INSIDE the scope, so the runtime
+                            # enforcer must name this exact callback
+                            faults.sleep_if("msgr.stall_dispatch",
+                                            self.name, 0.2)
                         try:
                             reply = handler(msg)
                         except faults.InjectedKill as e:

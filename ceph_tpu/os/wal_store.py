@@ -390,7 +390,7 @@ class WALStore(ObjectStore):
         if self._group_delay > 0:
             # widen the group: let concurrent writers land their
             # appends before the shared fsync (bounded by the knob)
-            time.sleep(self._group_delay)  # conc-ok: the sync mutex is the group-commit leader role, not a data lock; waiting here IS the coalescing window
+            time.sleep(self._group_delay)  # the sync mutex is the group-commit leader role, not a data lock; waiting here IS the coalescing window
         with self._lock:
             batch, self._pending = self._pending, []
             f, gen = self._wal_f, self._wal_gen
@@ -409,7 +409,7 @@ class WALStore(ObjectStore):
                     # poison itself — memory shows the txns but disk
                     # cannot prove them (the reference asserts out)
                     raise OSError(errno.EIO, "injected fsync error")
-                os.fsync(f.fileno())  # conc-ok: the shared group fsync IS the ack point; the sync mutex serializes leaders, appends proceed under the store lock meanwhile
+                os.fsync(f.fileno())  # the shared group fsync IS the ack point; the sync mutex serializes leaders, appends proceed under the store lock meanwhile
                 err = None
                 break
             except Exception as e:

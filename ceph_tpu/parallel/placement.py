@@ -175,7 +175,7 @@ class PlacementPlane:
             self.mesh.axis_names else self.mesh.axis_names[0]
         self.n_dev = int(np.asarray(self.mesh.devices).size)
         self._device_ids = [
-            int(d.id) for d in np.asarray(self.mesh.devices).ravel()]  # jax-ok: mesh.devices is a host-side numpy array of Device handles
+            int(d.id) for d in np.asarray(self.mesh.devices).ravel()]  # mesh.devices is a host-side numpy array of Device handles
         self._repl = NamedSharding(self.mesh, P())
         self._shard = NamedSharding(self.mesh, P(self.axis_name))
         self._encoded = encoded if encoded is not None \

@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ..analysis.asyncheck import nonblocking
 from ..analysis.lockdep import make_lock
 from ..analysis.racecheck import guarded_by
 
@@ -168,6 +169,7 @@ class HeartbeatPlane:
             except Exception as e:
                 self.log.derr(f"osd.{self.svc.id} hb tick: {e!r}")
 
+    @nonblocking
     def _tick(self) -> None:
         svc = self.svc
         now = time.monotonic()
@@ -183,7 +185,7 @@ class HeartbeatPlane:
             if addr is None:
                 continue  # can't ping -> no basis to condemn; the
                 # mon's beacon timeout owns an osd we can't even dial
-            svc.msgr.send(tuple(addr), {
+            svc.msgr.send(tuple(addr), {  # block-ok: lossless send is deadline-bounded (2s sequencing-lock timeout, fire-and-forget frame) — a dead peer costs a bounded stall, never a wedge
                 "type": "osd_ping", "osd": svc.id,
                 "addr": list(svc.addr), "stamp": now})
             self.pc.inc("pings")
@@ -195,23 +197,25 @@ class HeartbeatPlane:
             # re-sent every interval while the peer stays silent and
             # up in our map: the monitor's reports DECAY, so a live
             # claim must keep refreshing until check_failure acts
-            svc.mon_send({"type": "osd_failure", "osd": osd,
+            svc.mon_send({"type": "osd_failure", "osd": osd,  # block-ok: fire-and-forget mon report over the bounded lossless send path (2s sequencing timeout)
                           "frm_osd": svc.id,
                           "failed_for": round(failed_for, 3)})
             self.pc.inc("failures_reported")
 
     # -- handlers (both fire-and-forget, control lane) -----------------
+    @nonblocking
     def _h_ping(self, msg: Dict) -> None:
         # echo the stamp back to the pinger's listening address; our
         # own send is fire-and-forget too, so a half-dead link drops
         # the reply instead of wedging this handler
         addr = msg.get("addr")
         if addr:
-            self.svc.msgr.send(tuple(addr), {
+            self.svc.msgr.send(tuple(addr), {  # block-ok: fire-and-forget echo on the bounded lossless send path (2s sequencing timeout); a half-dead link drops the reply, never wedges the handler
                 "type": "osd_ping_reply", "osd": self.svc.id,
                 "stamp": msg.get("stamp", 0.0)})
         return None
 
+    @nonblocking
     def _h_ping_reply(self, msg: Dict) -> None:
         now = time.monotonic()
         rtt = max(0.0, now - float(msg.get("stamp", now)))

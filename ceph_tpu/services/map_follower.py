@@ -19,6 +19,7 @@ from __future__ import annotations
 import time
 from typing import Dict
 
+from ..analysis.asyncheck import nonblocking
 from ..common.backoff import Backoff
 from ..common.perf_counters import collection
 from ..osdmap.incremental import Incremental, apply_incremental
@@ -166,7 +167,7 @@ class MapFollower:
                 # (OSDMap::encode role, ~15x smaller than the JSON)
                 from ..osdmap.bincode_maps import osdmap_from_bytes
 
-                self.map = osdmap_from_bytes(payload["map_bin"])
+                self.map = osdmap_from_bytes(payload["map_bin"])  # block-ok: pure in-memory bincode decode — the per-type struct-reader table defeats static resolution, but no reader touches a socket, file, or lock
             else:
                 self.map = OSDMap.from_dict(payload["map"])
             self.epoch = payload["epoch"]
@@ -186,6 +187,7 @@ class MapFollower:
             self._pg_cache = {}
             return True
 
+    @nonblocking
     def _h_map_inc(self, msg: Dict) -> None:
         inc = Incremental.from_dict(msg["inc"])
         with self._lock:
@@ -196,7 +198,7 @@ class MapFollower:
                 self._set_extras(msg)
             self._post_map_install()
             return None
-        self._catch_up(inc.epoch, msg)
+        self._catch_up(inc.epoch, msg)  # block-ok: gap catch-up is deadline-bounded (5s per mon_call, bounded tries) and best-effort — on timeout the monitor's next commit push retries; deferring it would leave the follower on a stale epoch indefinitely
         return None
 
     def _catch_up(self, target: int, msg: Dict) -> None:

@@ -29,6 +29,7 @@ import time
 from typing import Deque, Dict, List, Optional, Tuple
 
 from ..analysis import faults
+from ..analysis.asyncheck import nonblocking
 from ..analysis.lockdep import make_lock, make_rlock
 from ..analysis.racecheck import guarded_by
 from ..common import encoding
@@ -528,6 +529,7 @@ class Monitor:
         for p in pushers:
             p.push(msg)
 
+    @nonblocking
     def _h_get_inc(self, msg: Dict) -> Dict:
         with self._lock:
             got = self._incs.get(int(msg["epoch"]))
@@ -581,6 +583,7 @@ class Monitor:
         self.log.dout(1, f"osd.{osd} booted at {msg['addr']}")
         return {"epoch": self.map.epoch}
 
+    @nonblocking
     def _h_heartbeat(self, msg: Dict) -> None:
         osd = int(msg["osd"])
         push = None
@@ -615,6 +618,7 @@ class Monitor:
         self.pc.inc("beats")
         return None
 
+    @nonblocking
     def _h_get_map(self, msg: Dict) -> Dict:
         epoch = msg.get("epoch")
         if epoch is not None:
@@ -1131,6 +1135,7 @@ class Monitor:
             node = b.id
         return node
 
+    @nonblocking
     def _h_osd_failure(self, msg: Dict) -> None:
         """OSDMonitor::check_failure — a peer's osd_failure report.
         Mark down only once reports arrive from enough DISTINCT
@@ -1171,7 +1176,7 @@ class Monitor:
                 1, f"osd.{failed} failed by {len(subtrees)} "
                    f"subtree(s), reporters {reporters}")
             try:
-                self.mark_down(failed)
+                self.mark_down(failed)  # block-ok: markdown commits synchronously by design — epoch order would break if deferred; replicate is deadline-bounded (5s call timeout, dead peons skipped) and the store write is a local rename
             except RuntimeError as e:
                 self.log.derr(f"failure markdown aborted: {e}")
         return None

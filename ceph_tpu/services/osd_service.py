@@ -1918,7 +1918,7 @@ class OSDService(MapFollower):
                     shard_v, code)
                 pc.inc("serial_batches")
                 if pace > 0:
-                    time.sleep(pace)  # fault-ok: the
+                    time.sleep(pace)  # the
                     # osd_recovery_sleep pacing knob, not retry pacing
             return ok
         ex = self._recovery_executor()

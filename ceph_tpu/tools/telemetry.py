@@ -211,6 +211,10 @@ DEFAULT_COLUMNS: List[Tuple[str, str, str]] = [
     # recorded an Eraser lockset/confinement report since the last
     # poll (normally dead-zero; see dump_racecheck for the stacks)
     ("analysis.race", "violations", "race"),
+    # async-safety budget overruns/s — nonzero means a @nonblocking
+    # dispatch callback blew its wallclock budget since the last poll
+    # (normally dead-zero; see dump_asyncheck for both-end stacks)
+    ("analysis.block", "overruns", "blk"),
 ]
 
 

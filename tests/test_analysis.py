@@ -8,15 +8,22 @@ wedged daemon would be debugged with.  The racecheck suite is the
 data-race twin: a synthetic racy class is caught with both access
 stacks, clean code under its declared guard stays silent, and the
 Eraser state machine's edges (init phase, publication, thread
-confinement, lockset refinement) are each pinned.
+confinement, lockset refinement) are each pinned.  The asyncheck
+suite covers the blocking-safety plane's runtime half: a scope that
+overruns its budget is recorded with both witnesses, the Enforcer
+names a stall WHILE the callback is still blocking, and the
+``__hello__`` reply offload is pinned against regression by
+re-running the static analyzer over a deliberately reverted
+messenger.
 """
 
+import pathlib
 import threading
 import time
 
 import pytest
 
-from ceph_tpu.analysis import lockdep, racecheck, watchdog
+from ceph_tpu.analysis import asyncheck, lockdep, racecheck, watchdog
 
 
 def test_lockdep_catches_inverted_lock_pair():
@@ -583,3 +590,175 @@ def test_lockdep_cross_thread_release_scrubs_holder():
         assert "tcx::other" not in lockdep._follows.get("tcx::gen", {})
     finally:
         lockdep.forget("tcx::")
+
+
+# ---------------------------------------------------------------------
+# asyncheck: @nonblocking contracts + loop-stall enforcement
+# ---------------------------------------------------------------------
+#
+# The plane is wallclock-based, so tier-1 drives it deterministically:
+# _forced is monkeypatched (auto-restored) instead of arming
+# CEPH_TPU_ASYNCHECK suite-wide, budgets are per-scope overrides, and
+# Enforcer.poll() is called directly — no enforcer thread to leak into
+# the conftest thread gate.
+
+
+def test_asyncheck_disabled_is_identity(monkeypatch):
+    """Decoration while the plane is off must be a true no-op: the
+    decorator returns the function itself (zero production overhead)
+    and scope()/poll() record nothing."""
+    monkeypatch.setattr(asyncheck, "_forced", False)
+
+    def fn():
+        return 1
+
+    assert asyncheck.nonblocking(fn) is fn
+    with asyncheck.trap() as got:
+        with asyncheck.scope("tas::off", budget_ms=0.0):
+            time.sleep(0.005)
+    assert not got
+    assert asyncheck.Enforcer().poll() == []
+
+
+def test_asyncheck_exit_overrun_records_both_stacks(monkeypatch):
+    monkeypatch.setattr(asyncheck, "_forced", True)
+    with asyncheck.trap() as got:
+        with asyncheck.scope("tas::slow", budget_ms=1.0):
+            time.sleep(0.02)
+        with asyncheck.scope("tas::fast", budget_ms=5000.0):
+            pass  # within budget: silent
+    assert [v["scope"] for v in got] == ["tas::slow"]
+    rec = got[0]
+    assert rec["kind"] == "overrun"
+    assert rec["elapsed_ms"] > rec["budget_ms"]
+    assert "tas::slow" in rec["message"]
+    # both witnesses point back here: who declared the scope, and
+    # the exit path it finally returned through
+    assert "test_analysis.py" in rec["entry_stack"]
+    assert "test_analysis.py" in rec["witness_stack"]
+
+
+def test_asyncheck_enforcer_names_midstall_scope(monkeypatch):
+    """The in-flight half: a poll finds a scope still open past
+    budget and captures the owning thread's CURRENT stack — the
+    witness that names the blocking call while it blocks.  The
+    later exit must not double-report the scope."""
+    monkeypatch.setattr(asyncheck, "_forced", True)
+    entered = threading.Event()
+    release = threading.Event()
+
+    def victim():
+        with asyncheck.scope("tas::victim", budget_ms=1.0):
+            entered.set()
+            release.wait(5)  # the blocking call the witness names
+
+    th = threading.Thread(target=victim, name="tas-victim")
+    with asyncheck.trap() as got:
+        th.start()
+        try:
+            assert entered.wait(5)
+            enf = asyncheck.Enforcer()
+            made = []
+            deadline = time.monotonic() + 5
+            while not made and time.monotonic() < deadline:
+                time.sleep(0.01)
+                made = enf.poll()
+            # the live-overrun view (dump_asyncheck's payload) sees
+            # the same stall without an enforcer
+            live = asyncheck.live_overruns()
+        finally:
+            release.set()
+            th.join(timeout=5)
+        assert not th.is_alive()
+    assert made, "enforcer never witnessed the stall"
+    rec = made[0]
+    assert rec["kind"] == "stall"
+    assert rec["scope"] == "tas::victim"
+    assert rec["thread"] == "tas-victim"
+    assert "still blocked" in rec["message"]
+    assert "victim" in rec["entry_stack"]
+    assert "wait" in rec["witness_stack"]  # names release.wait mid-flight
+    assert any(o["scope"] == "tas::victim" and "wait" in o["stack"]
+               for o in live)
+    # poll marked the scope reported: its exit adds no second record
+    assert [v["scope"] for v in got].count("tas::victim") == 1
+
+
+def test_asyncheck_nonblocking_decorator_enforces_budget(monkeypatch):
+    """@nonblocking decorated while the plane is on: registers the
+    contract and times the body against the module budget."""
+    monkeypatch.setattr(asyncheck, "_forced", True)
+    monkeypatch.setattr(asyncheck, "_budget_ms", 1.0)
+
+    @asyncheck.nonblocking
+    def slow_handler():
+        time.sleep(0.02)
+        return 7
+
+    assert any(c.endswith("slow_handler")
+               for c in asyncheck.dump()["contracts"])
+    with asyncheck.trap() as got:
+        assert slow_handler() == 7
+    assert len(got) == 1
+    assert "slow_handler" in got[0]["scope"]
+    assert got[0]["kind"] == "overrun"
+
+
+def test_asyncheck_gate_accept_and_reject(monkeypatch):
+    """The gate pair mirrors racecheck's: a clean window passes, a
+    window with an overrun fails with both witnesses formatted, and
+    the check drains the buffer."""
+    monkeypatch.setattr(asyncheck, "_forced", True)
+    base = asyncheck.mark()
+    assert asyncheck.gate_check(base) is None  # clean window
+    with asyncheck.scope("tas::gate", budget_ms=1.0):
+        time.sleep(0.02)
+    msg = asyncheck.gate_check(base)
+    assert msg is not None
+    assert "tas::gate" in msg
+    assert "scope entered at" in msg and "witness" in msg
+    # drained: nothing left for a later gate
+    assert not asyncheck.violations()
+
+
+def test_messenger_hello_reply_stays_off_reader_thread(tmp_path):
+    """Regression for the blocking-under-dispatch bug BLOCK001
+    found: the ``__hello__`` handshake reply was sent inline on the
+    reader thread (_dispatch -> _reply -> _send -> sendall), so one
+    backpressured peer socket froze acks, replies and dispatch for
+    every frame behind it on that connection.  Pin both halves:
+    lexically, the hello reply goes through _pool_submit; statically,
+    reverting it to an inline _reply resurfaces the full BLOCK001
+    chain under the analyzer that caught it."""
+    from tools import lint_async
+
+    import ceph_tpu.msg.messenger as messenger
+
+    src_path = pathlib.Path(messenger.__file__)
+    src = src_path.read_text()
+    offloaded = ("self._pool_submit(self._reply, conn, msg,\n"
+                 "                                  "
+                 "{\"in_seq\": ins.in_seq, \"ok\": True},\n"
+                 "                                  control=True)")
+    assert offloaded in src, "hello reply no longer offloaded"
+
+    # the fix keeps the messenger clean under single-file analysis
+    clean, _ = lint_async.analyze([src_path])
+    assert clean == []
+
+    # revert the hunk: the pre-fix inline reply on the reader thread
+    bad = tmp_path / "messenger.py"
+    bad.write_text(src.replace(
+        offloaded,
+        "self._reply(conn, msg,\n"
+        "                            "
+        "{\"in_seq\": ins.in_seq, \"ok\": True})"))
+    vs, _ = lint_async.analyze([bad])
+    chains = [v.message for v in vs if v.code == "BLOCK001"]
+    assert chains, "analyzer lost the reverted hello-reply bug"
+    assert any("@nonblocking 'Messenger._dispatch'" in m
+               and "Messenger._reply" in m
+               and "Messenger._send" in m
+               for m in chains)
+    # the terminal primitive is the peer-socket send
+    assert any("sendall" in m or "sendmsg" in m for m in chains)

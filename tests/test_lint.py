@@ -13,7 +13,7 @@ import pathlib
 import textwrap
 
 from tools.lint_concurrency import lint_file, lint_paths
-from tools import lint_jax, lint_wire
+from tools import lint_async, lint_jax, lint_wire
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
@@ -1237,4 +1237,328 @@ def test_lint_runner_cli_exit_status(tmp_path):
         [sys.executable, str(REPO / "tools" / "lint.py"), str(good)],
         capture_output=True, text=True)
     assert p.returncode == 0
-    assert "lint clean (6 families)" in p.stdout
+    assert "lint clean (7 families)" in p.stdout
+
+
+def test_lint_runner_json_output(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import threading\nx = threading.Lock()\n")
+    p = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"), "--json",
+         str(bad)],
+        capture_output=True, text=True)
+    assert p.returncode == 1
+    rep = json.loads(p.stdout)
+    assert rep["ok"] is False
+    assert set(rep["families"]) == {
+        "async", "concurrency", "config", "faults", "jax", "obs",
+        "wire"}
+    conc = rep["families"]["concurrency"]
+    assert conc["rc"] == 1
+    assert any("CONC001" in f for f in conc["findings"])
+    assert conc["elapsed_s"] >= 0
+    # clean target: every family rc 0, no findings, one exit code
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    p = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"), "--json",
+         str(good)],
+        capture_output=True, text=True)
+    assert p.returncode == 0
+    rep = json.loads(p.stdout)
+    assert rep["ok"] is True
+    assert all(f["rc"] == 0 and not f["findings"]
+               for f in rep["families"].values())
+
+
+def test_suppression_audit_repo_is_clean():
+    """Every ``# <fam>-ok:`` mark in the repo names a real family,
+    carries a reason, and still suppresses a finding — the audit
+    sweep that keeps suppressions honest."""
+    from tools import lint as lint_runner
+
+    assert lint_runner.audit_suppressions(REPO) == 0
+
+
+def test_suppression_audit_flags_bad_marks(tmp_path):
+    """A typo'd family word and a reasonless mark both fail the
+    audit (SUP001/SUP002); stale detection is covered by the
+    repo-wide clean run above."""
+    import contextlib
+    import io
+
+    from tools import lint as lint_runner
+
+    sub = tmp_path / "ceph_tpu"
+    sub.mkdir()
+    (sub / "mod.py").write_text(
+        "import time\n"
+        "x = 1  # blok-ok: typo'd family word\n"
+        "y = 2  # conc-ok:\n")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = lint_runner.audit_suppressions(tmp_path)
+    assert rc == 1
+    out = buf.getvalue()
+    assert "SUP001" in out and "blok" in out
+    assert "SUP002" in out and "no reason" in out
+
+
+# ---------------------------------------------------------------------------
+# Async-safety reachability (tools/lint_async.py, BLOCK001)
+# ---------------------------------------------------------------------------
+
+def _alint(tmp_path, source):
+    f = tmp_path / "mod.py"
+    f.write_text(textwrap.dedent(source))
+    return lint_async.lint_file(f)
+
+
+def test_repo_is_async_clean():
+    """The asyncheck static pass IS tier-1: zero unsuppressed
+    may-block paths reachable from any @nonblocking context across
+    the project (msg/, services/, mgr/ and everything else)."""
+    violations = lint_async.lint_paths([REPO / "ceph_tpu"])
+    assert not violations, "\n".join(str(v) for v in violations)
+
+
+def test_block001_direct_primitive_flagged(tmp_path):
+    vs = _alint(tmp_path, """\
+        import time
+        from ceph_tpu.analysis.asyncheck import nonblocking
+
+        @nonblocking
+        def handler(msg):
+            time.sleep(0.1)
+    """)
+    assert codes(vs) == ["BLOCK001"]
+    assert "time.sleep" in vs[0].message
+
+
+def test_block001_transitive_chain_named(tmp_path):
+    """The report carries the full static call chain from the
+    @nonblocking root to the primitive, not just the endpoint."""
+    vs = _alint(tmp_path, """\
+        import time
+        from ceph_tpu.analysis.asyncheck import nonblocking
+
+        def deep():
+            time.sleep(0.1)
+
+        def mid():
+            deep()
+
+        @nonblocking
+        def handler(msg):
+            mid()
+    """)
+    assert codes(vs) == ["BLOCK001"]
+    msg = vs[0].message
+    assert "handler" in msg and "mid" in msg and "deep" in msg
+
+
+def test_block001_decorated_callee_transparent(tmp_path):
+    """A decorator on the callee must not hide its blocking body —
+    the analyzer sees through the decoration to the def."""
+    vs = _alint(tmp_path, """\
+        import functools
+        import time
+
+        from ceph_tpu.analysis.asyncheck import nonblocking
+
+        def logged(fn):
+            @functools.wraps(fn)
+            def w(*a, **k):
+                return fn(*a, **k)
+            return w
+
+        @logged
+        def drain():
+            time.sleep(0.2)
+
+        @nonblocking
+        def handler(msg):
+            drain()
+    """)
+    assert "BLOCK001" in codes(vs)
+
+
+def test_block001_lambda_bound_callee(tmp_path):
+    vs = _alint(tmp_path, """\
+        import time
+        from ceph_tpu.analysis.asyncheck import nonblocking
+
+        @nonblocking
+        def handler(msg):
+            f = lambda: time.sleep(0.5)
+            f()
+    """)
+    assert "BLOCK001" in codes(vs)
+
+
+def test_block001_functools_partial(tmp_path):
+    """partial(fn, ...) bound to a local and called: the call edge
+    lands on the wrapped function."""
+    vs = _alint(tmp_path, """\
+        import functools
+        import time
+
+        from ceph_tpu.analysis.asyncheck import nonblocking
+
+        def flush_all(n):
+            time.sleep(n)
+
+        @nonblocking
+        def handler(msg):
+            f = functools.partial(flush_all, 3)
+            f()
+    """)
+    assert "BLOCK001" in codes(vs)
+
+
+def test_block001_inherited_method(tmp_path):
+    """self.m() resolves through the class's MRO: a blocking method
+    inherited from a base is reachable from the subclass handler."""
+    vs = _alint(tmp_path, """\
+        import time
+        from ceph_tpu.analysis.asyncheck import nonblocking
+
+        class Base:
+            def drain(self):
+                time.sleep(1.0)
+
+        class Worker(Base):
+            @nonblocking
+            def handle(self, msg):
+                self.drain()
+    """)
+    assert "BLOCK001" in codes(vs)
+
+
+def test_block001_dynamic_callback_conservative(tmp_path):
+    """self._callbacks[type](msg)-style value-dependent dispatch
+    cannot be resolved statically: the analyzer assumes may-block and
+    SAYS it assumed (the documented conservative fallback)."""
+    vs = _alint(tmp_path, """\
+        from ceph_tpu.analysis.asyncheck import nonblocking
+
+        class Dispatcher:
+            def __init__(self):
+                self._callbacks = {}
+
+            @nonblocking
+            def handle(self, msg):
+                self._callbacks[msg["type"]](msg)
+    """)
+    assert codes(vs) == ["BLOCK001"]
+    assert "conservative" in vs[0].message
+
+
+def test_block001_pool_submit_is_not_an_edge(tmp_path):
+    """Passing a blocking fn AS AN ARGUMENT creates no call edge —
+    handing work to a pool/thread is the off-loop idiom the analyzer
+    must not punish."""
+    vs = _alint(tmp_path, """\
+        import time
+        from ceph_tpu.analysis.asyncheck import nonblocking
+
+        def slow():
+            time.sleep(1.0)
+
+        @nonblocking
+        def handler(msg, pool):
+            pool.submit(slow)
+    """)
+    assert vs == []
+
+
+def test_block001_nonblocking_acquire_ok(tmp_path):
+    """lock.acquire(blocking=False) never waits — not a primitive."""
+    vs = _alint(tmp_path, """\
+        from ceph_tpu.analysis.asyncheck import nonblocking
+
+        @nonblocking
+        def handler(msg, lk):
+            if not lk.acquire(blocking=False):
+                return None
+            lk.release()
+    """)
+    assert vs == []
+
+
+def test_block001_mark_suppresses_with_reason(tmp_path):
+    vs = _alint(tmp_path, """\
+        import time
+        from ceph_tpu.analysis.asyncheck import nonblocking
+
+        @nonblocking
+        def handler(msg):
+            time.sleep(0.01)  # block-ok: bounded pacing, 10ms by construction
+    """)
+    assert vs == []
+
+
+def test_block001_mark_requires_reason(tmp_path):
+    vs = _alint(tmp_path, """\
+        import time
+        from ceph_tpu.analysis.asyncheck import nonblocking
+
+        @nonblocking
+        def handler(msg):
+            time.sleep(0.01)  # block-ok:
+    """)
+    # the bare mark suppresses NOTHING: the primitive still reports,
+    # plus one violation naming the reasonless mark itself
+    assert codes(vs) == ["BLOCK001", "BLOCK001"]
+    assert any("no reason" in v.message for v in vs)
+
+
+def test_block001_edge_mark_cuts_subtree(tmp_path):
+    """A mark on a CALL EDGE suppresses everything reachable through
+    it — one reasoned mark at the fan-out site covers the whole
+    bounded-send machinery behind it."""
+    vs = _alint(tmp_path, """\
+        import time
+        from ceph_tpu.analysis.asyncheck import nonblocking
+
+        def deep():
+            time.sleep(0.1)
+
+        @nonblocking
+        def handler(msg):
+            deep()  # block-ok: deadline-bounded by the 2s frame timeout
+    """)
+    assert vs == []
+
+
+def test_async_cli_exit_status(tmp_path):
+    import subprocess
+    import sys
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""\
+        import time
+        from ceph_tpu.analysis.asyncheck import nonblocking
+
+        @nonblocking
+        def handler(msg):
+            time.sleep(0.1)
+    """))
+    p = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_async.py"),
+         str(bad)],
+        capture_output=True, text=True)
+    assert p.returncode == 1
+    assert "BLOCK001" in p.stdout
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    p = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint_async.py"),
+         str(good)],
+        capture_output=True, text=True)
+    assert p.returncode == 0
+    assert "async lint clean" in p.stdout

@@ -8,6 +8,9 @@ violation — the single CI entry point, so a new lint family added to
 the FAMILIES registry against the ``lint_*.py`` module set on disk).
 
 Families:
+    async        BLOCK001     may-block ops reachable from
+                              @nonblocking dispatch contexts
+                              (whole-program call-graph walk)
     concurrency  CONC001-005  lock registry, blocking-under-lock,
                               swallowed run-loops, span leaks,
                               unguarded writes to declared state
@@ -23,25 +26,43 @@ Families:
 Usage:
     python tools/lint.py [paths...]   # default: each family's own
                                       # default target (ceph_tpu/)
-Exit status 1 when any family found violations.
+    python tools/lint.py --json       # machine-readable per-family
+                                      # findings + timings, one exit
+                                      # code
+    python tools/lint.py --audit-suppressions
+                                      # audit every ``# <fam>-ok:``
+                                      # mark in the repo: must name a
+                                      # real family, carry a reason,
+                                      # and still suppress something
+
+Exit status 1 when any family found violations (or, under
+``--audit-suppressions``, when any mark fails the audit).
 """
 
 from __future__ import annotations
 
+import contextlib
+import io
+import json
 import pathlib
+import re
 import sys
-from typing import List
+import tempfile
+import time
+import tokenize
+from typing import Dict, List, Tuple
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-from tools import (lint_concurrency, lint_config, lint_faults,  # noqa: E402
-                   lint_jax, lint_obs, lint_wire)
+from tools import (lint_async, lint_concurrency, lint_config,  # noqa: E402
+                   lint_faults, lint_jax, lint_obs, lint_wire)
 
 # family key -> the module whose main() runs it; keys are the
 # lint_*.py stem minus the prefix (tests pin this against the on-disk
 # module set, so adding tools/lint_foo.py without registering it here
 # fails the suite)
 FAMILIES = {
+    "async": lint_async,
     "concurrency": lint_concurrency,
     "config": lint_config,
     "faults": lint_faults,
@@ -50,13 +71,183 @@ FAMILIES = {
     "wire": lint_wire,
 }
 
+# suppression-mark word -> owning family key.  Every ``# <word>-ok:``
+# comment in the repo must appear here — a typo'd mark suppresses
+# nothing silently, which is exactly what --audit-suppressions exists
+# to catch.
+MARK_FAMILIES: Dict[str, str] = {
+    "block": "async",
+    "conc": "concurrency",
+    "race": "concurrency",
+    "conf": "config",
+    "fault": "faults",
+    "jax": "jax",
+    "obs": "obs",
+    "copy": "obs",
+    "wire": "wire",
+}
 
+# directories the suppression audit sweeps (repo-relative)
+AUDIT_DIRS = ("ceph_tpu", "tools", "tests")
+
+# a real mark opens its comment (``code  # fam-ok: reason``); a doc
+# comment that merely MENTIONS a mark mid-sentence is not audited
+_MARK_RE = re.compile(r"^#+\s*([A-Za-z_]+)-ok:(.*)")
+# a printed violation line: ``path:line: CODE001 message``
+_FINDING_RE = re.compile(r"^\S+:\d+: [A-Z]+\d+\b")
+
+
+# -- suppression audit -------------------------------------------------
+def _iter_marks(repo: pathlib.Path):
+    """Yield (path, lineno, family_word, reason) for every
+    ``# <word>-ok:`` COMMENT token under AUDIT_DIRS.  Marks quoted
+    inside strings/docstrings (lint documentation, test fixtures) are
+    not comments and are not audited."""
+    for d in AUDIT_DIRS:
+        base = repo / d
+        if not base.is_dir():
+            continue
+        for f in sorted(base.rglob("*.py")):
+            try:
+                toks = tokenize.generate_tokens(
+                    io.StringIO(f.read_text()).readline)
+                for tok in toks:
+                    if tok.type != tokenize.COMMENT:
+                        continue
+                    m = _MARK_RE.match(tok.string)
+                    if m:
+                        yield (f, tok.start[0], m.group(1),
+                               m.group(2).strip())
+            except (SyntaxError, tokenize.TokenError,
+                    UnicodeDecodeError):
+                continue
+
+
+# strips the leading "# fam-ok:" of a mark comment, leaving "#"
+_MARK_STRIP_RE = re.compile(r"#+\s*[A-Za-z_]+-ok:")
+
+
+def _strip_relint(repo: pathlib.Path, path: pathlib.Path,
+                  lineno: int, word: str) -> bool:
+    """True when the mark at ``path:lineno`` is STALE: removing it
+    does not increase the owning family's finding count for the file.
+    Both runs lint a temp MIRROR that preserves the repo-relative
+    path (allowlists and per-subtree checks key on it), so the two
+    runs differ only by the mark and baseline findings cancel out."""
+    mod = FAMILIES[MARK_FAMILIES[word]]
+    rel = path.relative_to(repo)
+    lines = path.read_text().splitlines(keepends=True)
+    if lineno > len(lines):
+        return True
+    stripped = list(lines)
+    stripped[lineno - 1] = _MARK_STRIP_RE.sub(
+        "#", stripped[lineno - 1], count=1)
+    with tempfile.TemporaryDirectory() as td:
+        counts = []
+        for sub, text in (("with_mark", lines), ("no_mark", stripped)):
+            root = pathlib.Path(td) / sub
+            f = root / rel
+            f.parent.mkdir(parents=True)
+            f.write_text("".join(text))
+            try:
+                try:
+                    vs = mod.lint_file(f, root=root)
+                except TypeError:  # family takes no root kwarg
+                    vs = mod.lint_file(f)
+            except Exception:
+                return False  # can't judge -> keep (conservative)
+            counts.append(len(vs))
+    return counts[1] <= counts[0]
+
+
+def audit_suppressions(repo: pathlib.Path,
+                       as_json: bool = False) -> int:
+    """Audit every suppression mark in the repo: the family word must
+    exist, the reason must be non-empty, and the mark must still
+    suppress a finding (block marks are checked against the set of
+    marks the whole-program walk actually consulted; other families
+    are strip-and-relinted per file)."""
+    # one whole-program walk gives the consulted # block-ok: set —
+    # per-file relinting cannot see reachability, so a block mark is
+    # stale exactly when no @nonblocking walk consulted it
+    _avs, used_block = lint_async.analyze(
+        [repo / d for d in AUDIT_DIRS if (repo / d).is_dir()])
+    findings: List[str] = []
+    marks: List[Tuple[pathlib.Path, int, str, str]] = \
+        list(_iter_marks(repo))
+    for path, lineno, word, reason in marks:
+        rel = path.relative_to(repo).as_posix()
+        if word not in MARK_FAMILIES:
+            findings.append(
+                f"{rel}:{lineno}: SUP001 mark '# {word}-ok:' names no "
+                f"lint family (known: "
+                f"{', '.join(sorted(MARK_FAMILIES))}) — it suppresses "
+                f"nothing")
+            continue
+        if not reason:
+            findings.append(
+                f"{rel}:{lineno}: SUP002 mark '# {word}-ok:' carries "
+                f"no reason — the reason is the review record")
+            continue
+        if word == "block":
+            stale = (rel, lineno) not in used_block
+        else:
+            stale = _strip_relint(repo, path, lineno, word)
+        if stale:
+            findings.append(
+                f"{rel}:{lineno}: SUP003 stale mark '# {word}-ok:' — "
+                f"removing it produces no "
+                f"{MARK_FAMILIES[word]} finding; delete the mark")
+    if as_json:
+        print(json.dumps({"marks": len(marks),
+                          "findings": findings,
+                          "ok": not findings}, indent=2))
+        return 1 if findings else 0
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"suppression audit FAILED: {len(findings)} of "
+              f"{len(marks)} mark(s)")
+        return 1
+    print(f"suppression audit clean ({len(marks)} marks)")
+    return 0
+
+
+# -- the family runner -------------------------------------------------
 def main(argv: List[str]) -> int:
+    argv = list(argv)
+    as_json = "--json" in argv
+    if as_json:
+        argv.remove("--json")
+    if "--audit-suppressions" in argv:
+        argv.remove("--audit-suppressions")
+        repo = pathlib.Path(__file__).resolve().parents[1]
+        return audit_suppressions(repo, as_json=as_json)
     failed = []
+    results: Dict[str, Dict] = {}
     for name in sorted(FAMILIES):
-        print(f"== lint: {name} ==")
-        if FAMILIES[name].main(list(argv)) != 0:
+        t0 = time.monotonic()
+        if as_json:
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                rc = FAMILIES[name].main(list(argv))
+            findings = [ln for ln in buf.getvalue().splitlines()
+                        if _FINDING_RE.match(ln)]
+        else:
+            print(f"== lint: {name} ==")
+            rc = FAMILIES[name].main(list(argv))
+            findings = []
+        results[name] = {
+            "rc": rc,
+            "findings": findings,
+            "elapsed_s": round(time.monotonic() - t0, 3),
+        }
+        if rc != 0:
             failed.append(name)
+    if as_json:
+        print(json.dumps({"families": results, "ok": not failed},
+                         indent=2))
+        return 1 if failed else 0
     if failed:
         print(f"lint FAILED: {', '.join(failed)}")
         return 1

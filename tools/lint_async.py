@@ -1,0 +1,728 @@
+#!/usr/bin/env python
+"""Async-safety lint — may-block reachability from ``@nonblocking``.
+
+The static half of ceph_tpu/analysis/asyncheck.py (the runtime twin
+times declared scopes against a wallclock budget): a project-wide AST
+call-graph walk that proves which primitive blocking operations are
+reachable from a declared non-blocking context — Linux's
+sleep-in-atomic checker, for this codebase — enforced by
+tests/test_lint.py:
+
+BLOCK001  a primitive may-block operation reachable through the
+          static call graph from a function decorated
+          ``@nonblocking`` (analysis/asyncheck.py).  The report
+          carries the full call chain root -> ... -> primitive, each
+          hop with its call-site line.  Primitives:
+
+            * ``time.sleep`` / bare ``sleep``
+            * ``*.wait(...)``       Event/Condition wait (bounded
+                                    waits still stall the loop for
+                                    the bound — mark with the bound
+                                    as the reason)
+            * ``*.acquire(...)``    lock acquire, unless
+                                    ``blocking=False``
+            * ``*.result(...)``     Future result
+            * ``*.get(...)``        on queue-ish receivers (name
+                                    contains ``queue``/``fifo`` or
+                                    ends ``_q``), or with a
+                                    ``timeout=``/``block=`` kwarg
+            * ``os.fsync`` / ``*.fsync`` / ``*.flush``
+            * socket ops: ``recv``/``recv_into``/``recvfrom``/
+              ``recvmsg``/``accept``/``connect``/``sendall``/
+              ``sendmsg``/``create_connection``
+            * ``subprocess.*``
+            * ``*.join(...)``       on thread-ish receivers
+
+Call-graph resolution, and its two documented fallbacks:
+
+  * bare names resolve through local binds (nested defs, lambdas,
+    ``functools.partial(f, ...)`` assignments), imports (project
+    imports follow the graph, stdlib imports are primitive-table-
+    classified), module-level functions, and class constructors
+    (``C()`` follows ``C.__init__``);
+  * ``self.m()`` resolves through the class registry's MRO (inherited
+    methods included);
+  * ``obj.m()`` on any other receiver resolves by class-hierarchy
+    analysis: edges to EVERY project method named ``m`` — except
+    generic container/stdlib method names (``get``, ``update``,
+    ``submit``, ...), which resolve only through ``self`` (CHA on
+    ``d.get(...)`` would wire every dict read to every project
+    ``get``);
+  * CONSERVATIVE fallback: a call whose callee is a computed value —
+    a subscript (``self._handlers[t](msg)``), a call result, a bound
+    dynamic lookup (``cb = self._cbs.get(k); cb()``), a function
+    parameter, or an unresolvable bare name — is assumed MAY-BLOCK
+    and reported as a primitive at the call site.  Dynamic dispatch
+    is where blocking hides; the analyzer refuses to guess.
+  * OPTIMISTIC fallback: a named attribute call matching no project
+    symbol and no primitive pattern (``json.dumps``, ``math.floor``)
+    is assumed non-blocking — the primitive table names the stdlib
+    blockers.
+
+Arguments are not callees: ``pool.submit(fn)`` / ``Thread(target=fn)``
+create NO edge to ``fn`` — handing work off the loop is exactly the
+non-blocking idiom.  Decorators are assumed transparent (a call to a
+decorated name follows the def).
+
+Suppression: append ``# block-ok: <reason>`` to the primitive line
+(suppresses that site for every root) or to a call-site line (cuts
+that edge).  The reason is mandatory — it is the allowlist entry, and
+for bounded waits it must name the bound.
+
+Usage:
+    python tools/lint_async.py [paths...]   # default: ceph_tpu/
+Exit status 1 when violations are found.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import pathlib
+import sys
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+MARK = "block-ok:"
+
+SOCKET_ATTRS = {"recv", "recv_into", "recvfrom", "recvmsg", "accept",
+                "connect", "sendall", "sendmsg"}
+
+# attribute names that resolve only through ``self.`` — CHA on these
+# generic container/stdlib method names would wire every dict/list/
+# executor call to same-named project methods
+GENERIC_ATTRS = {
+    "get", "put", "set", "pop", "update", "keys", "values", "items",
+    "copy", "clear", "add", "append", "appendleft", "extend",
+    "insert", "remove", "sort", "count", "index", "join", "split",
+    "strip", "format", "encode", "decode", "setdefault", "popitem",
+    "popleft", "submit", "close", "release", "discard", "info",
+    "debug", "warning", "error",
+    # socket.shutdown(SHUT_RDWR) would CHA-wire every raw-socket
+    # close to project daemons' shutdown() methods, and Encoder/
+    # Thread/span .start()/.stop() to daemon lifecycle methods
+    "shutdown", "start", "stop",
+}
+
+_BUILTINS = frozenset(dir(builtins))
+
+
+@dataclass
+class Violation:
+    path: str
+    line: int
+    code: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _queueish(recv: str) -> bool:
+    tail = recv.rsplit(".", 1)[-1].lower()
+    return ("queue" in tail or "fifo" in tail or tail == "q"
+            or tail.endswith("_q"))
+
+
+def _threadish(recv: str) -> bool:
+    tail = recv.rsplit(".", 1)[-1].lower()
+    return "thread" in tail or "proc" in tail
+
+
+def _recv_text(expr: ast.AST) -> str:
+    try:
+        return ast.unparse(expr)
+    except Exception:
+        return "?"
+
+
+def _kw(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _primitive(call: ast.Call) -> Optional[str]:
+    """The primitive may-block table: a description when this call
+    blocks by its own nature, else None."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        if f.id == "sleep":
+            return "sleep [time.sleep]"
+        if f.id == "fsync":
+            return "fsync [durability barrier]"
+        if f.id == "create_connection":
+            return "create_connection [socket connect]"
+        return None
+    if not isinstance(f, ast.Attribute):
+        return None
+    attr = f.attr
+    recv = _recv_text(f.value)
+    if attr == "sleep":
+        return f"{recv}.sleep [time.sleep]"
+    if attr == "wait":
+        return f"{recv}.wait [event/condition wait]"
+    if attr == "acquire":
+        b = _kw(call, "blocking")
+        if isinstance(b, ast.Constant) and b.value is False:
+            return None
+        return f"{recv}.acquire [lock wait]"
+    if attr == "result":
+        return f"{recv}.result [future wait]"
+    if attr == "fsync":
+        return f"{recv}.fsync [durability barrier]"
+    if attr == "flush":
+        return f"{recv}.flush [buffered-io flush]"
+    if attr in SOCKET_ATTRS:
+        return f"{recv}.{attr} [socket {attr}]"
+    if attr == "create_connection":
+        return f"{recv}.create_connection [socket connect]"
+    if recv.rsplit(".", 1)[-1] == "subprocess":
+        return f"subprocess.{attr} [child process]"
+    if attr == "get":
+        if _queueish(recv) or _kw(call, "timeout") is not None \
+                or _kw(call, "block") is not None:
+            return f"{recv}.get [queue get]"
+        return None
+    if attr == "join" and _threadish(recv):
+        return f"{recv}.join [thread join]"
+    return None
+
+
+def _is_partial(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name) and f.id == "partial":
+        return True
+    return isinstance(f, ast.Attribute) and f.attr == "partial"
+
+
+def _is_nonblocking_deco(dec: ast.AST) -> bool:
+    if isinstance(dec, ast.Name):
+        return dec.id == "nonblocking"
+    if isinstance(dec, ast.Attribute):
+        return dec.attr == "nonblocking"
+    return False
+
+
+class _FileInfo:
+    __slots__ = ("rel", "lines", "modules", "from_imports")
+
+    def __init__(self, rel: str, lines: List[str]):
+        self.rel = rel
+        self.lines = lines
+        # alias -> module name (``import X as a``)
+        self.modules: Dict[str, str] = {}
+        # alias -> (module, original name) (``from M import n as a``)
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+
+
+def _project_module(mod: str) -> bool:
+    return (mod.startswith(".") or mod.startswith("ceph_tpu")
+            or mod.startswith("tools"))
+
+
+class _Func:
+    """One analyzed function/method/lambda: its primitive sites and
+    outgoing call edges (specs resolved after all files parse)."""
+
+    __slots__ = ("qual", "cls", "file", "lineno", "prims", "calls",
+                 "is_root", "edges")
+
+    def __init__(self, qual: str, cls: Optional[str], file: _FileInfo,
+                 lineno: int):
+        self.qual = qual
+        self.cls = cls
+        self.file = file
+        self.lineno = lineno
+        # (lineno, end_lineno, desc) primitive may-block sites
+        self.prims: List[Tuple[int, int, str]] = []
+        # (lineno, end_lineno, spec) unresolved call edges
+        self.calls: List[Tuple[int, int, tuple]] = []
+        self.is_root = False
+        # resolved: (lineno, end_lineno, target _Func)
+        self.edges: List[Tuple[int, int, "_Func"]] = []
+
+
+class _Class:
+    __slots__ = ("name", "bases", "methods")
+
+    def __init__(self, name: str, bases: List[str]):
+        self.name = name
+        self.bases = bases
+        self.methods: Dict[str, _Func] = {}
+
+
+class _Env:
+    """Per-function-body name environment: parameters (calls through
+    them are dynamic) and local binds (nested defs, lambdas, partial
+    results, dynamic lookups)."""
+
+    __slots__ = ("params", "binds")
+
+    def __init__(self, params: Set[str]):
+        self.params = params
+        self.binds: Dict[str, tuple] = {}
+
+
+class _Project:
+    """The whole-program view: every parsed file's classes/functions
+    plus the name tables resolution consults."""
+
+    def __init__(self):
+        self.classes: Dict[str, _Class] = {}
+        self.funcs_by_name: Dict[str, List[_Func]] = {}
+        self.methods_by_name: Dict[str, List[_Func]] = {}
+        self.roots: List[_Func] = []
+        self.all_funcs: List[_Func] = []
+        self.violations: List[Violation] = []
+        # (rel, lineno) of every consulted # block-ok: mark — the
+        # staleness set lint.py --audit-suppressions reads
+        self.used_marks: Set[Tuple[str, int]] = set()
+        self._no_reason: Set[Tuple[str, int]] = set()
+        self._reported: Set[Tuple[str, int, str]] = set()
+
+    # -- parsing ------------------------------------------------------
+
+    def add_file(self, path: pathlib.Path,
+                 root: Optional[pathlib.Path]) -> None:
+        rel = str(path if root is None else path.relative_to(root))
+        src = path.read_text()
+        try:
+            tree = ast.parse(src, filename=str(path))
+        except SyntaxError as e:
+            self.violations.append(Violation(
+                rel, e.lineno or 0, "BLOCK000",
+                f"unparseable: {e.msg}"))
+            return
+        fi = _FileInfo(rel, src.splitlines())
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Import):
+                for a in n.names:
+                    fi.modules[a.asname or a.name.split(".")[0]] = \
+                        a.name
+            elif isinstance(n, ast.ImportFrom):
+                mod = ("." * n.level) + (n.module or "")
+                for a in n.names:
+                    fi.from_imports[a.asname or a.name] = \
+                        (mod, a.name)
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                f = self._def_func(node, None, fi)
+                self.funcs_by_name.setdefault(node.name,
+                                              []).append(f)
+            elif isinstance(node, ast.ClassDef):
+                self._add_class(node, fi)
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Lambda) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                f = self._lambda_func(node.value,
+                                      node.targets[0].id, None, fi)
+                self.funcs_by_name.setdefault(
+                    node.targets[0].id, []).append(f)
+
+    def _add_class(self, node: ast.ClassDef, fi: _FileInfo) -> None:
+        bases = []
+        for b in node.bases:
+            if isinstance(b, ast.Name):
+                bases.append(b.id)
+            elif isinstance(b, ast.Attribute):
+                bases.append(b.attr)
+        cls = self.classes.setdefault(node.name,
+                                      _Class(node.name, bases))
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                f = self._def_func(item, node.name, fi)
+                cls.methods[item.name] = f
+                self.methods_by_name.setdefault(item.name,
+                                                []).append(f)
+
+    def _def_func(self, node, cls: Optional[str],
+                  fi: _FileInfo) -> _Func:
+        qual = f"{cls}.{node.name}" if cls else node.name
+        f = _Func(qual, cls, fi, node.lineno)
+        f.is_root = any(_is_nonblocking_deco(d)
+                        for d in node.decorator_list)
+        if f.is_root:
+            self.roots.append(f)
+        self.all_funcs.append(f)
+        args = node.args
+        params = {a.arg for a in (args.posonlyargs + args.args
+                                  + args.kwonlyargs)}
+        if args.vararg:
+            params.add(args.vararg.arg)
+        if args.kwarg:
+            params.add(args.kwarg.arg)
+        env = _Env(params)
+        for stmt in node.body:
+            self._scan(stmt, f, env)
+        return f
+
+    def _lambda_func(self, node: ast.Lambda, name: str,
+                     cls: Optional[str], fi: _FileInfo) -> _Func:
+        f = _Func(f"{name}<lambda>", cls, fi, node.lineno)
+        self.all_funcs.append(f)
+        args = node.args
+        params = {a.arg for a in (args.posonlyargs + args.args
+                                  + args.kwonlyargs)}
+        env = _Env(params)
+        self._scan(node.body, f, env)
+        return f
+
+    # -- per-body scan ------------------------------------------------
+
+    def _callee_spec(self, expr: ast.AST, fn: _Func,
+                     env: _Env) -> tuple:
+        """Classify a callee expression into a resolution spec."""
+        if isinstance(expr, ast.Name):
+            nm = expr.id
+            if nm in env.binds:
+                return env.binds[nm]
+            if nm in env.params:
+                return ("dynamic",
+                        f"call through parameter {nm!r}")
+            return ("name", nm)
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and \
+                    expr.value.id == "self":
+                return ("self", expr.attr)
+            if isinstance(expr.value, ast.Call) and \
+                    isinstance(expr.value.func, ast.Name) and \
+                    expr.value.func.id == "super":
+                return ("super", expr.attr)
+            return ("attr", expr.attr, _recv_text(expr.value))
+        if isinstance(expr, ast.Lambda):
+            return ("func",
+                    self._lambda_func(expr, "<inline>", fn.cls,
+                                      fn.file))
+        if isinstance(expr, ast.Call):
+            if _is_partial(expr) and expr.args:
+                return self._callee_spec(expr.args[0], fn, env)
+            return ("dynamic", "call on a call result")
+        if isinstance(expr, ast.Subscript):
+            return ("dynamic",
+                    f"call through container lookup "
+                    f"{_recv_text(expr)!r}")
+        return ("dynamic", f"computed callee {_recv_text(expr)!r}")
+
+    def _scan(self, node: ast.AST, fn: _Func, env: _Env) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = self._def_func(node, fn.cls, fn.file)
+            env.binds[node.name] = ("func", inner)
+            return  # own body already scanned with a fresh env
+        if isinstance(node, ast.Lambda):
+            self._lambda_func(node, "<inline>", fn.cls, fn.file)
+            return
+        if isinstance(node, ast.Assign) and \
+                len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            v = node.value
+            if isinstance(v, ast.Lambda):
+                env.binds[name] = (
+                    "func", self._lambda_func(v, name, fn.cls,
+                                              fn.file))
+                return
+            if isinstance(v, ast.Call) and _is_partial(v) and v.args:
+                env.binds[name] = self._callee_spec(v.args[0], fn,
+                                                    env)
+                for a in v.args[1:]:
+                    self._scan(a, fn, env)
+                for kw in v.keywords:
+                    self._scan(kw.value, fn, env)
+                return
+            if isinstance(v, (ast.Name, ast.Attribute)):
+                spec = self._callee_spec(v, fn, env)
+                if spec[0] != "dynamic":
+                    env.binds[name] = spec
+                self._scan(v, fn, env)
+                return
+            if isinstance(v, (ast.Call, ast.Subscript)):
+                # ``cb = self._cbs.get(k)`` — a later ``cb()`` is a
+                # dynamic call (the conservative fallback)
+                env.binds[name] = (
+                    "dynamic",
+                    f"{name!r} bound from "
+                    f"{_recv_text(v)!r}")
+                self._scan(v, fn, env)
+                return
+        if isinstance(node, ast.Call):
+            endl = getattr(node, "end_lineno", None) or node.lineno
+            desc = _primitive(node)
+            if desc is not None:
+                fn.prims.append((node.lineno, endl, desc))
+            else:
+                spec = self._callee_spec(node.func, fn, env)
+                if spec[0] == "dynamic":
+                    fn.prims.append((
+                        node.lineno, endl,
+                        f"dynamic call ({spec[1]}): assumed "
+                        f"may-block (conservative fallback)"))
+                elif spec[0] != "safe":
+                    fn.calls.append((node.lineno, endl, spec))
+            for a in node.args:
+                self._scan(a, fn, env)
+            for kw in node.keywords:
+                self._scan(kw.value, fn, env)
+            # a computed func expression may itself contain calls
+            if not isinstance(node.func, (ast.Name, ast.Attribute)):
+                self._scan(node.func, fn, env)
+            elif isinstance(node.func, ast.Attribute):
+                self._scan(node.func.value, fn, env)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._scan(child, fn, env)
+
+    # -- resolution ---------------------------------------------------
+
+    def _mro_lookup(self, cls_name: str,
+                    attr: str) -> Optional[_Func]:
+        seen: Set[str] = set()
+        stack = [cls_name]
+        while stack:
+            nm = stack.pop(0)
+            if nm in seen:
+                continue
+            seen.add(nm)
+            c = self.classes.get(nm)
+            if c is None:
+                continue
+            if attr in c.methods:
+                return c.methods[attr]
+            stack.extend(c.bases)
+        return None
+
+    def _resolve(self, fn: _Func, spec: tuple,
+                 lineno: int) -> Tuple[List[_Func], Optional[str]]:
+        """spec -> (target functions, dynamic-fallback description)."""
+        kind = spec[0]
+        if kind == "func":
+            return [spec[1]], None
+        if kind == "name":
+            nm = spec[1]
+            fi = fn.file
+            if nm in fi.from_imports:
+                mod, orig = fi.from_imports[nm]
+                if _project_module(mod):
+                    fs = self.funcs_by_name.get(orig)
+                    if fs:
+                        return list(fs), None
+                    c = self.classes.get(orig)
+                    if c is not None:
+                        init = self._mro_lookup(orig, "__init__")
+                        return ([init] if init else []), None
+                return [], None  # stdlib import: primitive-table job
+            if nm in fi.modules:
+                return [], None
+            fs = self.funcs_by_name.get(nm)
+            if fs:
+                return list(fs), None
+            if nm in self.classes:
+                init = self._mro_lookup(nm, "__init__")
+                return ([init] if init else []), None
+            if nm in _BUILTINS:
+                return [], None
+            return [], (f"unresolvable name {nm!r}: assumed "
+                        f"may-block (conservative fallback)")
+        if kind == "self":
+            attr = spec[1]
+            if fn.cls:
+                m = self._mro_lookup(fn.cls, attr)
+                if m is not None:
+                    return [m], None
+            ms = self.methods_by_name.get(attr)
+            if ms:
+                return list(ms), None
+            return [], (f"self.{attr} resolves to no known method: "
+                        f"assumed may-block (conservative fallback)")
+        if kind == "super":
+            attr = spec[1]
+            if fn.cls and fn.cls in self.classes:
+                for base in self.classes[fn.cls].bases:
+                    m = self._mro_lookup(base, attr)
+                    if m is not None:
+                        return [m], None
+            return [], None  # external base (Exception, Thread, ...)
+        if kind == "attr":
+            attr = spec[1]
+            if attr in GENERIC_ATTRS:
+                return [], None
+            if attr.startswith("__") and attr.endswith("__"):
+                # dunder CHA (x.__init__, cm.__exit__) wires every
+                # constructor/protocol call project-wide; dunders
+                # resolve only through Name-call constructors and
+                # self/super
+                return [], None
+            root = spec[2].split(".", 1)[0].split("(", 1)[0]
+            fi = fn.file
+            mod = fi.modules.get(root)
+            if mod is None and root in fi.from_imports:
+                m, orig = fi.from_imports[root]
+                mod = f"{m}.{orig}" if _project_module(m) else "stdlib"
+            if mod is not None:
+                # the receiver IS a module: a project module's
+                # functions join the graph, a stdlib module's are
+                # primitive-table-classified
+                if _project_module(mod):
+                    return list(self.funcs_by_name.get(attr, ())), \
+                        None
+                return [], None
+            # object receiver: CHA over project METHODS of this name
+            # (module-level functions of the same name are unrelated)
+            return list(self.methods_by_name.get(attr, ())), None
+        return [], None
+
+    def link(self) -> None:
+        """Resolve every recorded call spec into graph edges (and
+        fold dynamic fallbacks into primitive sites)."""
+        for fn in self.all_funcs:
+            for lineno, endl, spec in fn.calls:
+                targets, dyn = self._resolve(fn, spec, lineno)
+                if dyn is not None:
+                    fn.prims.append((
+                        lineno, endl,
+                        f"dynamic call ({dyn})"))
+                for t in targets:
+                    fn.edges.append((lineno, endl, t))
+
+    # -- suppression --------------------------------------------------
+
+    def _mark_at(self, fn: _Func, lineno: int,
+                 endl: int) -> Optional[Tuple[int, str]]:
+        """(mark line, reason) when a # block-ok: mark covers the
+        statement spanning lineno..endl."""
+        lines = fn.file.lines
+        for ln in range(lineno, min(endl, lineno + 10,
+                                    len(lines)) + 1):
+            if MARK in lines[ln - 1]:
+                return ln, lines[ln - 1].split(MARK, 1)[1].strip()
+        return None
+
+    def _consume_mark(self, fn: _Func, lineno: int,
+                      endl: int) -> bool:
+        """True when a valid (reasoned) mark suppresses this site;
+        an empty reason emits its own violation and suppresses
+        nothing."""
+        hit = self._mark_at(fn, lineno, endl)
+        if hit is None:
+            return False
+        mline, reason = hit
+        if reason:
+            self.used_marks.add((fn.file.rel, mline))
+            return True
+        key = (fn.file.rel, mline)
+        if key not in self._no_reason:
+            self._no_reason.add(key)
+            self.violations.append(Violation(
+                fn.file.rel, mline, "BLOCK001",
+                "'# block-ok:' carries no reason — the reason is "
+                "the allowlist entry"))
+        return False
+
+    # -- reachability -------------------------------------------------
+
+    def _chain(self, parent: Dict[int, Tuple[_Func, int]],
+               fn: _Func) -> str:
+        hops = []
+        cur: Optional[_Func] = fn
+        while cur is not None:
+            prev = parent.get(id(cur))
+            if prev is None:
+                hops.append(cur.qual)
+                break
+            pfn, ln = prev
+            hops.append(f"{cur.qual} "
+                        f"({pathlib.Path(cur.file.rel).name}:"
+                        f"{cur.lineno}, called at "
+                        f"{pathlib.Path(pfn.file.rel).name}:{ln})")
+            cur = pfn
+        return " -> ".join(reversed(hops))
+
+    def report(self) -> None:
+        for root in sorted(self.roots,
+                           key=lambda f: (f.file.rel, f.lineno)):
+            visited: Set[int] = {id(root)}
+            parent: Dict[int, Tuple[_Func, int]] = {}
+            queue: List[_Func] = [root]
+            while queue:
+                fn = queue.pop(0)
+                for lineno, endl, desc in fn.prims:
+                    if self._consume_mark(fn, lineno, endl):
+                        continue
+                    key = (fn.file.rel, lineno, desc)
+                    if key in self._reported:
+                        continue  # one report per site; the fix or
+                        # mark there covers every root reaching it
+                    self._reported.add(key)
+                    chain = self._chain(parent, fn)
+                    self.violations.append(Violation(
+                        fn.file.rel, lineno, "BLOCK001",
+                        f"may-block op {desc} reachable from "
+                        f"@nonblocking {root.qual!r} via: {chain} "
+                        f"-> [{desc} at line {lineno}]; move it "
+                        f"off-loop, bound it, or mark the site "
+                        f"'# block-ok: <reason>'"))
+                for lineno, endl, tgt in fn.edges:
+                    if id(tgt) in visited:
+                        continue
+                    if self._consume_mark(fn, lineno, endl):
+                        continue
+                    visited.add(id(tgt))
+                    parent[id(tgt)] = (fn, lineno)
+                    queue.append(tgt)
+
+
+def analyze(paths: Iterable[pathlib.Path]
+            ) -> Tuple[List[Violation], Set[Tuple[str, int]]]:
+    """Whole-program analysis over ``paths``; returns the violation
+    list and the set of (relpath, lineno) # block-ok: marks the walk
+    actually consulted (lint.py --audit-suppressions' staleness
+    input)."""
+    proj = _Project()
+    for p in paths:
+        p = pathlib.Path(p)
+        if p.is_dir():
+            root = p.parent
+            for f in sorted(p.rglob("*.py")):
+                proj.add_file(f, root)
+        else:
+            proj.add_file(p, None)
+    proj.link()
+    proj.report()
+    return (sorted(proj.violations, key=lambda v: (v.path, v.line)),
+            proj.used_marks)
+
+
+def lint_file(path: pathlib.Path,
+              root: Optional[pathlib.Path] = None) -> List[Violation]:
+    if root is not None:
+        vs, _ = analyze([root / pathlib.Path(path).relative_to(root)
+                         if pathlib.Path(path).is_absolute()
+                         else path])
+    else:
+        vs, _ = analyze([path])
+    return vs
+
+
+def lint_paths(paths: Iterable[pathlib.Path]) -> List[Violation]:
+    return analyze(paths)[0]
+
+
+def main(argv: List[str]) -> int:
+    targets = [pathlib.Path(a) for a in argv] or \
+        [pathlib.Path(__file__).resolve().parents[1] / "ceph_tpu"]
+    violations = lint_paths(targets)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} async-safety lint violation(s)")
+        return 1
+    print("async lint clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -66,7 +66,7 @@ RACE_MARK = "race-ok:"
 # (and racecheck, whose violation-record lock must not feed back into
 # the lockset checker it implements)
 ALLOW_RAW_FILES = ("analysis/lockdep.py", "analysis/watchdog.py",
-                   "analysis/racecheck.py")
+                   "analysis/racecheck.py", "analysis/asyncheck.py")
 
 # names whose .attr call blocks by design
 BLOCKING_ATTRS = {"fsync", "recv", "sleep"}

@@ -67,6 +67,8 @@ METRICS = [
     ("netsplit_epoch_churn", False),
     ("race_violations", False),
     ("race_overhead_pct", False),
+    ("async_violations", False),
+    ("async_overhead_pct", False),
     ("attr_unattr_pct", False),
     ("copy_bytes_per_op", False),
     ("prof_overhead_pct", False),
@@ -383,6 +385,45 @@ def load_race(path: str) -> Optional[Dict]:
     return {"metrics": metrics, "fail": fail}
 
 
+def load_async(path: str) -> Optional[Dict]:
+    """One ASYNC_rNN.json loop-stall record (tools/thrasher.py
+    --loop-stall): the static-violation count and enforcement
+    overhead join the trajectory, and the gate is absolute — ANY
+    unsuppressed BLOCK001 reachability violation, any acked-write
+    loss, an unnamed victim callback, a cluster that failed to heal,
+    a failed drill verdict, or enforcement overhead at/over 5% is a
+    regression outright (a blocking dispatch loop has no acceptable
+    drift)."""
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"# {path}: unreadable ({e})", file=sys.stderr)
+        return None
+    metrics: Dict[str, float] = {}
+    if isinstance(raw.get("static_violations"), (int, float)):
+        metrics["async_violations"] = \
+            float(raw["static_violations"])
+    if isinstance(raw.get("overhead_pct"), (int, float)):
+        metrics["async_overhead_pct"] = float(raw["overhead_pct"])
+    fail: List[str] = []
+    if raw.get("static_violations"):
+        fail.append(
+            f"async_violations={raw['static_violations']}")
+    if raw.get("lost"):
+        fail.append(f"async_lost_writes={raw['lost']}")
+    if not raw.get("victim_named"):
+        fail.append("async_victim_unnamed")
+    if not raw.get("cleared"):
+        fail.append("async_not_healed")
+    ov = raw.get("overhead_pct")
+    if not isinstance(ov, (int, float)) or ov >= 5.0:
+        fail.append(f"async_enforcer_overhead={ov}")
+    if raw.get("ok") is False:
+        fail.append("loop_stall_drill_failed")
+    return {"metrics": metrics, "fail": fail}
+
+
 def load_all(directory: str) -> List[Dict]:
     rows = []
     for path in sorted(glob.glob(os.path.join(directory,
@@ -519,6 +560,28 @@ def load_all(directory: str) -> List[Dict]:
         for k, v in rc_["metrics"].items():
             row["metrics"].setdefault(k, v)
         row["slo_fail"].extend(rc_["fail"])
+    # ASYNC_rNN loop-stall records: static-violation count and
+    # enforcement overhead merge onto the same-numbered row; any
+    # violation, lost write, unnamed victim, failed heal or overhead
+    # breach rides slo_fail into the regression check
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "ASYNC_r*.json"))):
+        m = re.search(r"ASYNC_r(\d+)\.json$", path)
+        ac = load_async(path)
+        if ac is None or m is None or \
+                not (ac["metrics"] or ac["fail"]):
+            continue
+        n = int(m.group(1))
+        row = by_n.get(n)
+        if row is None:
+            row = {"run": f"r{n:02d}", "n": n,
+                   "path": os.path.basename(path), "rc": None,
+                   "platform": None, "metrics": {}, "slo_fail": []}
+            by_n[n] = row
+            rows.append(row)
+        for k, v in ac["metrics"].items():
+            row["metrics"].setdefault(k, v)
+        row["slo_fail"].extend(ac["fail"])
     rows.sort(key=lambda r: r["n"])
     return rows
 

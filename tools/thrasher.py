@@ -66,6 +66,15 @@ probes the checker's overhead on a clean write lane in paired
 subprocesses (checker on vs off).  The ``RACE_rNN.json`` record is
 red-checked hard by tools/perf_history.py: any lockset/confinement
 violation, any acked-write loss, or >=10% checker overhead fails.
+
+``--loop-stall`` runs the async-safety drill: the
+``msgr.stall_dispatch`` failpoint delays one OSD's control-lane
+dispatch callbacks inside their ``@nonblocking`` scopes
+(analysis/asyncheck.py), the runtime enforcer must name the victim
+callback mid-stall with both-end stacks, and disarming must heal to
+HEALTH_OK with zero acked-write loss.  The ``ASYNC_rNN.json`` record
+is red-checked by tools/perf_history.py: any unsuppressed static
+BLOCK001 violation or >=5% enforcement overhead fails.
 """
 
 from __future__ import annotations
@@ -94,8 +103,13 @@ if _ROOT not in sys.path:
 if "--race-audit" in sys.argv:
     os.environ["CEPH_TPU_RACECHECK"] = "1"
     os.environ.setdefault("CEPH_TPU_LOCKDEP", "1")
+# --loop-stall arms the async-safety runtime, whose @nonblocking
+# decorators are decoration-time identity no-ops when disabled — same
+# before-any-import rule as the race audit
+if "--loop-stall" in sys.argv:
+    os.environ["CEPH_TPU_ASYNCHECK"] = "1"
 
-from ceph_tpu.analysis import faults, lockdep, racecheck  # noqa: E402
+from ceph_tpu.analysis import asyncheck, faults, lockdep, racecheck  # noqa: E402
 from ceph_tpu.common import tracing  # noqa: E402
 from ceph_tpu.common.admin_socket import AdminSocket  # noqa: E402
 from ceph_tpu.common.backoff import Backoff  # noqa: E402
@@ -201,7 +215,7 @@ def _verify(cluster: MiniCluster,
                         if not bo.sleep():
                             bad.append((w.pool, key, "mismatch"))
                             break
-                    except Exception as e:  # fault-ok: Backoff-paced
+                    except Exception as e:  # Backoff-paced
                         if not bo.sleep():
                             bad.append((w.pool, key, repr(e)))
                             break
@@ -475,7 +489,7 @@ def _kill_host_phase(seed: int, depth: int, n_osds: int, hosts: int,
                 t_first = time.monotonic()
             if _rebuilt():
                 break
-            time.sleep(0.005)  # fault-ok: measurement poll cadence
+            time.sleep(0.005)  # measurement poll cadence
         t_done = time.monotonic()
         c.set_faults("")  # readback + convergence at loopback speed
         try:
@@ -713,7 +727,7 @@ def _mon_partition_phase(seed: int, n_osds: int = 4,
             if victim not in c.status()["up_osds"]:
                 went_down = True
                 break
-            time.sleep(0.1)  # fault-ok: drill observation cadence
+            time.sleep(0.1)  # drill observation cadence
         c.set_faults("")
         out["false_markdowns"] = int(went_down) + max(
             0, int(c.mon.pc.dump().get("markdowns", 0)) - base_md)
@@ -768,7 +782,7 @@ def _isolation_phase(seed: int, n_osds: int = 4) -> Dict:
             if victim not in c.status()["up_osds"]:
                 detect = time.monotonic() - t0
                 break
-            time.sleep(0.05)  # fault-ok: detection-latency poll
+            time.sleep(0.05)  # detection-latency poll
         out["detect_s"] = round(detect, 3) if detect else None
         # hold through down->out so the markdown/out interplay runs
         # while the victim is dark, then heal: the victim's beats
@@ -1002,15 +1016,18 @@ def write_bench(seed: int = 8, duration: float = 4.0,
     return out
 
 
-def _bench_overhead(seed: int, runs: int = 3) -> Dict:
-    """Best-of-N write-bench ops/s with the checker armed vs
-    disarmed, each in its own subprocess (the guard declarations are
-    decoration-time, so an in-process toggle would measure nothing)."""
+def _bench_overhead(seed: int, runs: int = 3,
+                    env_var: str = "CEPH_TPU_RACECHECK") -> Dict:
+    """Best-of-N write-bench ops/s with the checker named by
+    ``env_var`` armed vs disarmed, each in its own subprocess (the
+    guard/contract declarations are decoration-time, so an in-process
+    toggle would measure nothing).  Shared by --race-audit
+    (CEPH_TPU_RACECHECK) and --loop-stall (CEPH_TPU_ASYNCHECK)."""
     import subprocess
 
     def probe(armed: bool) -> float:
         env = dict(os.environ)
-        env["CEPH_TPU_RACECHECK"] = "1" if armed else "0"
+        env[env_var] = "1" if armed else "0"
         env.setdefault("CEPH_TPU_LOCKDEP", "1")
         env.setdefault("JAX_PLATFORMS", "cpu")
         best = 0.0
@@ -1032,6 +1049,108 @@ def _bench_overhead(seed: int, runs: int = 3) -> Dict:
     return {"ops_per_s_checked": on, "ops_per_s_raw": off,
             "overhead_pct": round(max(0.0, (1 - on / off) * 100), 2)
             if off else None}
+
+
+def loop_stall_drill(seed: int = 8, n_osds: int = 3) -> Dict:
+    """The async-safety drill (``--loop-stall``): arm
+    ``msgr.stall_dispatch`` over one OSD so every control-lane
+    dispatch callback on the victim sleeps 0.25s INSIDE its
+    ``@nonblocking`` scope (5x the 50ms budget).  The runtime
+    enforcer must catch the stall in flight and name the victim
+    callback (a ``handler:osd.N:<type>`` scope) with both-end stacks
+    — the contract entry stack and the mid-stall witness — while the
+    static pass stays clean (the delay is a fault hook, invisible to
+    the call graph on purpose: this is exactly the dynamic blocking
+    the runtime twin exists for).  Disarm must heal to HEALTH_OK
+    with zero acked-write loss, and enforcement overhead on a clean
+    write lane must stay under 5%."""
+    if not asyncheck.enabled():
+        raise RuntimeError(
+            "loop_stall needs CEPH_TPU_ASYNCHECK=1 before ceph_tpu "
+            "imports (run via --loop-stall)")
+    import pathlib
+
+    from tools import lint_async
+
+    rng = random.Random(seed)
+    faults.reset()
+    faults.seed(seed)
+    conf = _conf()
+    conf.set("asyncheck_loop_budget_ms", 50.0)
+    c = MiniCluster(n_osds=n_osds, config=conf).start()
+    out: Dict = {"kind": "async", "seed": seed, "n_osds": n_osds,
+                 "budget_ms": 50.0}
+    # the static half of the gate: zero unsuppressed BLOCK001
+    # reachability violations project-wide
+    out["static_violations"] = len(lint_async.lint_paths(
+        [pathlib.Path(_ROOT) / "ceph_tpu"]))
+    try:
+        c.create_replicated_pool(1, pg_num=8, size=3)
+        c.wait_for_health_ok()
+        base = asyncheck.mark()
+        w = _Writer(c, 0, 1, ec=False)
+        w.start()
+        victim = rng.randrange(n_osds)
+        out["victim"] = victim
+        want = f"handler:osd.{victim}:"
+        t0 = time.monotonic()
+        c.set_faults(
+            f"msgr.stall_dispatch=p:1.0,delay:0.25,"
+            f"who:osd.{victim}")
+        named: Optional[str] = None
+        stalled = False
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            for r in asyncheck.violations()[base:]:
+                if str(r["scope"]).startswith(want):
+                    named = named or str(r["scope"])
+                    if r["kind"] == "stall":
+                        stalled = True
+            if named and stalled:
+                break
+            time.sleep(0.1)
+        out["raise_s"] = round(time.monotonic() - t0, 2)
+        recs = [r for r in asyncheck.violations()[base:]
+                if str(r["scope"]).startswith(want)]
+        out["victim_scope"] = named
+        out["victim_named"] = named is not None
+        out["stall_witnessed"] = stalled
+        out["overruns"] = len(recs)
+        out["both_stacks"] = bool(recs) and all(
+            r["entry_stack"] and r["witness_stack"]
+            for r in recs[:10])
+        # the admin surface serves the same evidence per daemon
+        d = AdminSocket.request(
+            os.path.join(c.asok_dir, f"osd.{victim}.asok"),
+            "dump_asyncheck")
+        out["dump_contracts"] = len(d.get("contracts", []))
+        c.set_faults("")
+        w.stop.set()
+        w.join(timeout=20)
+        bad = _verify(c, [w])
+        out["checked"] = len(w.acked)
+        out["lost"] = len(bad)
+        t1 = time.monotonic()
+        cleared = False
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline:
+            if c.health().get("status") == "HEALTH_OK":
+                cleared = True
+                break
+            time.sleep(0.5)
+        out["cleared"] = cleared
+        out["clear_s"] = round(time.monotonic() - t1, 2)
+    finally:
+        c.shutdown()
+        faults.reset()
+    out.update(_bench_overhead(seed, env_var="CEPH_TPU_ASYNCHECK"))
+    out["ok"] = bool(
+        out["static_violations"] == 0 and out["victim_named"]
+        and out["stall_witnessed"] and out["overruns"] > 0
+        and out["both_stacks"] and out["lost"] == 0
+        and out["cleared"] and out["overhead_pct"] is not None
+        and out["overhead_pct"] < 5.0)
+    return out
 
 
 def race_audit(seed: int = 8, soak_duration: float = 8.0) -> Dict:
@@ -1125,8 +1244,17 @@ def main(argv=None) -> int:
                          "then the checker-overhead probe; the gate "
                          "is zero violations, zero acked-write loss "
                          "and <10%% overhead (emits RACE_rNN.json)")
+    ap.add_argument("--loop-stall", action="store_true",
+                    help="run the async-safety drill: delay one "
+                         "OSD's control-lane dispatch callbacks "
+                         "inside their @nonblocking scopes; the "
+                         "runtime enforcer must name the victim "
+                         "callback with both-end stacks, then heal "
+                         "to HEALTH_OK; gates static cleanliness "
+                         "and <5%% enforcement overhead (emits "
+                         "ASYNC_rNN.json)")
     ap.add_argument("--write-bench", action="store_true",
-                    help=argparse.SUPPRESS)  # race-audit's subprocess
+                    help=argparse.SUPPRESS)  # overhead-probe subprocess
     ap.add_argument("--slo-p99-ms", type=float, default=250.0,
                     help="degraded-read soak p99 SLO in ms "
                          "(default 250)")
@@ -1145,7 +1273,8 @@ def main(argv=None) -> int:
     series = "DRILL" if args.host_kill else \
         "NETSPLIT" if args.netsplit else \
         "SLODRILL" if args.slow_ops else \
-        "RACE" if args.race_audit else "CHAOS"
+        "RACE" if args.race_audit else \
+        "ASYNC" if args.loop_stall else "CHAOS"
     out = args.out
     if out is None:
         n = next_run_number(_ROOT)
@@ -1153,6 +1282,8 @@ def main(argv=None) -> int:
     m = re.search(r"_r(\d+)\.json$", out)
     if args.race_audit:
         rec = race_audit(seed=args.seed)
+    elif args.loop_stall:
+        rec = loop_stall_drill(seed=args.seed)
     elif args.host_kill:
         rec = drill(seed=args.seed, slo_p99_ms=args.slo_p99_ms)
     elif args.netsplit:
@@ -1176,6 +1307,16 @@ def main(argv=None) -> int:
               f"overhead={rec.get('overhead_pct')}% "
               f"({rec.get('ops_per_s_checked')} vs "
               f"{rec.get('ops_per_s_raw')} op/s) -> "
+              f"{'OK' if rec['ok'] else 'FAIL'} ({out})")
+    elif args.loop_stall:
+        print(f"# async seed={rec['seed']} victim=osd."
+              f"{rec.get('victim')} scope={rec.get('victim_scope')} "
+              f"overruns={rec.get('overruns')} "
+              f"static={rec.get('static_violations')} "
+              f"raise={rec.get('raise_s')}s "
+              f"clear={rec.get('clear_s')}s "
+              f"lost={rec.get('lost')}/{rec.get('checked')} "
+              f"overhead={rec.get('overhead_pct')}% -> "
               f"{'OK' if rec['ok'] else 'FAIL'} ({out})")
     elif args.slow_ops:
         print(f"# slowops seed={rec['seed']} victim=osd."
